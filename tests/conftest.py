@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so that sharded (`shard_map`)
+code paths execute exactly as they would on a v5e-8 — same program, mesh
+size is config (SURVEY.md §4 carry-over (c)).  The real-TPU benchmark path
+is exercised by bench.py, not the unit suite.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,60 @@
+from caps_tpu.okapi.schema import Schema
+from caps_tpu.okapi.types import CTFloat, CTInteger, CTNumber, CTString
+
+
+def test_node_property_keys_exact_combo():
+    s = Schema.empty().with_node_property_keys(
+        ["Person"], {"name": CTString, "age": CTInteger})
+    assert s.node_property_keys(["Person"]) == {"name": CTString, "age": CTInteger}
+    assert s.labels == frozenset({"Person"})
+
+
+def test_union_over_combos_makes_missing_nullable():
+    s = (Schema.empty()
+         .with_node_property_keys(["Person"], {"name": CTString, "age": CTInteger})
+         .with_node_property_keys(["Person", "Admin"], {"name": CTString, "level": CTInteger}))
+    keys = s.node_property_keys(["Person"])
+    assert keys["name"] == CTString
+    assert keys["age"] == CTInteger.nullable
+    assert keys["level"] == CTInteger.nullable
+    # exact combo query only sees its own keys
+    assert set(s.property_keys_for_combo(["Person"])) == {"name", "age"}
+
+
+def test_same_combo_twice_joins_types():
+    s = (Schema.empty()
+         .with_node_property_keys(["A"], {"x": CTInteger})
+         .with_node_property_keys(["A"], {"x": CTFloat, "y": CTString}))
+    keys = s.node_property_keys(["A"])
+    assert keys["x"] == CTNumber
+    assert keys["y"] == CTString.nullable
+
+
+def test_relationship_keys():
+    s = (Schema.empty()
+         .with_relationship_property_keys("KNOWS", {"since": CTInteger})
+         .with_relationship_property_keys("LIKES", {"since": CTFloat, "how": CTString}))
+    assert s.relationship_types == frozenset({"KNOWS", "LIKES"})
+    both = s.relationship_property_keys()
+    assert both["since"] == CTNumber
+    assert both["how"] == CTString.nullable
+    assert s.relationship_property_keys(["KNOWS"]) == {"since": CTInteger}
+
+
+def test_schema_union():
+    a = Schema.empty().with_node_property_keys(["A"], {"x": CTInteger})
+    b = (Schema.empty()
+         .with_node_property_keys(["A"], {"x": CTInteger, "y": CTString})
+         .with_relationship_property_keys("R", {}))
+    u = a + b
+    assert u.node_property_keys(["A"])["y"] == CTString.nullable
+    assert u.relationship_types == frozenset({"R"})
+
+
+def test_combinations_for():
+    s = (Schema.empty()
+         .with_node_property_keys(["A"], {})
+         .with_node_property_keys(["A", "B"], {})
+         .with_node_property_keys(["C"], {}))
+    assert set(s.combinations_for(["A"])) == {frozenset({"A"}), frozenset({"A", "B"})}
+    assert set(s.combinations_for([])) == set(s.label_combinations)

@@ -1,0 +1,64 @@
+import dataclasses
+
+from caps_tpu.okapi.trees import TreeNode
+
+
+@dataclasses.dataclass(frozen=True)
+class Leaf(TreeNode):
+    value: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Add(TreeNode):
+    lhs: TreeNode
+    rhs: TreeNode
+
+
+@dataclasses.dataclass(frozen=True)
+class Sum(TreeNode):
+    terms: tuple
+
+
+def test_children_and_walk():
+    t = Add(Leaf(1), Sum((Leaf(2), Leaf(3))))
+    assert [type(n).__name__ for n in t.walk()] == ["Add", "Leaf", "Sum", "Leaf", "Leaf"]
+    assert t.size == 5
+    assert t.height == 3
+
+
+def test_map_children_identity_preserves_sharing():
+    t = Add(Leaf(1), Leaf(2))
+    assert t.map_children(lambda c: c) is t
+
+
+def test_transform_up_rewrites():
+    t = Add(Leaf(1), Add(Leaf(2), Leaf(3)))
+
+    def const_fold(n):
+        if isinstance(n, Add) and isinstance(n.lhs, Leaf) and isinstance(n.rhs, Leaf):
+            return Leaf(n.lhs.value + n.rhs.value)
+        return n
+
+    assert t.transform_up(const_fold) == Leaf(6)
+
+
+def test_transform_down():
+    t = Add(Leaf(1), Leaf(2))
+
+    def bump(n):
+        return Leaf(n.value + 10) if isinstance(n, Leaf) else n
+
+    assert t.transform_down(bump) == Add(Leaf(11), Leaf(12))
+
+
+def test_collect_and_exists():
+    t = Add(Leaf(1), Sum((Leaf(2), Leaf(3))))
+    assert len(t.collect(lambda n: isinstance(n, Leaf))) == 3
+    assert t.exists(lambda n: isinstance(n, Leaf) and n.value == 3)
+    assert not t.exists(lambda n: isinstance(n, Leaf) and n.value == 9)
+
+
+def test_pretty_prints_all_nodes():
+    t = Add(Leaf(1), Leaf(2))
+    s = t.pretty()
+    assert "Add" in s and s.count("Leaf") == 2

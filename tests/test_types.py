@@ -1,0 +1,77 @@
+from caps_tpu.okapi.types import (
+    CTAny, CTBoolean, CTFloat, CTInteger, CTList, CTMap, CTNode, CTNull,
+    CTNumber, CTRelationship, CTString, CTVoid, from_python, join_all,
+)
+
+
+def test_nullable_material_roundtrip():
+    assert CTInteger.nullable.material == CTInteger
+    assert CTInteger.nullable.is_nullable
+    assert not CTInteger.is_nullable
+    assert CTNull.nullable == CTNull
+    assert CTAny.nullable == CTAny
+
+
+def test_join_numbers():
+    assert CTInteger.join(CTFloat) == CTNumber
+    assert CTInteger.join(CTInteger.nullable) == CTInteger.nullable
+    assert CTInteger.join(CTString) == CTAny
+
+
+def test_join_null_makes_nullable():
+    assert CTInteger.join(CTNull) == CTInteger.nullable
+    assert CTNull.join(CTString) == CTString.nullable
+
+
+def test_void_is_bottom():
+    assert CTVoid.join(CTBoolean) == CTBoolean
+    assert join_all([]) == CTVoid
+    assert CTVoid.meet(CTBoolean) == CTVoid
+
+
+def test_node_label_join_intersects():
+    ab = CTNode(["A", "B"])
+    ac = CTNode(["A", "C"])
+    assert ab.join(ac) == CTNode(["A"])
+    assert ab.meet(ac) == CTNode(["A", "B", "C"])
+    assert CTNode().join(ab) == CTNode()
+
+
+def test_rel_type_join_unions():
+    knows = CTRelationship(["KNOWS"])
+    likes = CTRelationship(["LIKES"])
+    assert knows.join(likes) == CTRelationship(["KNOWS", "LIKES"])
+    assert knows.meet(CTRelationship()) == knows
+    assert knows.meet(likes) == CTVoid
+    # empty set = any relationship
+    assert CTRelationship().join(knows) == CTRelationship()
+
+
+def test_list_join():
+    assert CTList(CTInteger).join(CTList(CTFloat)) == CTList(CTNumber)
+    assert CTList(CTInteger).join(CTList(CTNull)) == CTList(CTInteger.nullable)
+
+
+def test_subtype_and_could_be():
+    assert CTInteger.subtype_of(CTNumber)
+    assert CTInteger.subtype_of(CTAny)
+    assert not CTNumber.subtype_of(CTInteger)
+    assert CTNode(["A"]).subtype_of(CTNode())
+    assert CTNumber.could_be(CTInteger)
+    assert not CTString.could_be(CTInteger)
+
+
+def test_from_python():
+    assert from_python(None) == CTNull
+    assert from_python(True) == CTBoolean
+    assert from_python(3) == CTInteger
+    assert from_python(3.5) == CTFloat
+    assert from_python("x") == CTString
+    assert from_python([1, 2.0]) == CTList(CTNumber)
+    assert from_python({"a": 1}) == CTMap
+
+
+def test_repr():
+    assert repr(CTInteger.nullable) == "CTInteger?"
+    assert repr(CTNode(["A", "B"])) == "CTNode(A:B)"
+    assert repr(CTList(CTString)) == "CTList(CTString)"

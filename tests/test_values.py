@@ -1,0 +1,49 @@
+from caps_tpu.okapi.values import (
+    CypherNode, CypherRelationship, cypher_equals, cypher_lt, is_truthy,
+    order_key,
+)
+
+
+def test_node_identity_equality():
+    a1 = CypherNode(1, ["Person"], {"name": "Alice"})
+    a2 = CypherNode(1, ["Person"], {"name": "Changed"})
+    b = CypherNode(2, ["Person"], {"name": "Alice"})
+    assert a1 == a2
+    assert a1 != b
+    assert hash(a1) == hash(a2)
+
+
+def test_equals_three_valued():
+    assert cypher_equals(1, 1.0) is True
+    assert cypher_equals(1, 2) is False
+    assert cypher_equals(None, 1) is None
+    assert cypher_equals(None, None) is None
+    assert cypher_equals(True, 1) is False  # bool is not a number
+    assert cypher_equals("a", "a") is True
+    assert cypher_equals([1, None], [1, 2]) is None
+    assert cypher_equals([1, None], [2, None]) is False
+    assert cypher_equals([1, 2], [1, 2, 3]) is False
+    assert cypher_equals({"a": 1}, {"a": 1}) is True
+    assert cypher_equals({"a": None}, {"a": 1}) is None
+
+
+def test_lt_three_valued():
+    assert cypher_lt(1, 2) is True
+    assert cypher_lt(2, 1) is False
+    assert cypher_lt(1, None) is None
+    assert cypher_lt(1, "a") is None  # incomparable types
+    assert cypher_lt("a", "b") is True
+    assert cypher_lt([1, 2], [1, 3]) is True
+
+
+def test_order_key_nulls_last_and_cross_type():
+    vals = [3, None, 1, "b", "a", True, 2.5]
+    ordered = sorted(vals, key=order_key)
+    # strings < booleans < numbers < null per openCypher global order
+    assert ordered == ["a", "b", True, 1, 2.5, 3, None]
+
+
+def test_is_truthy():
+    assert is_truthy(True)
+    assert not is_truthy(False)
+    assert not is_truthy(None)
