@@ -1,0 +1,72 @@
+import pytest
+
+from caps_tpu.frontend.lexer import (
+    EOF, FLOAT, IDENT, INT, KEYWORD, STRING, SYM, CypherSyntaxError, tokenize,
+)
+
+
+def kinds(q):
+    return [(t.kind, t.text) for t in tokenize(q)[:-1]]
+
+
+def test_keywords_case_insensitive():
+    assert kinds("match RETURN Where") == [
+        (KEYWORD, "MATCH"), (KEYWORD, "RETURN"), (KEYWORD, "WHERE")]
+
+
+def test_identifiers_and_backticks():
+    assert kinds("foo `weird name` _x1") == [
+        (IDENT, "foo"), (IDENT, "weird name"), (IDENT, "_x1")]
+
+
+def test_numbers():
+    toks = tokenize("42 3.14 1e3 0x1F")
+    assert [(t.kind, t.value) for t in toks[:-1]] == [
+        (INT, 42), (FLOAT, 3.14), (FLOAT, 1000.0), (INT, 31)]
+
+
+def test_leading_dot_float_in_expression_context():
+    toks = tokenize("(.5)")
+    assert [(t.kind, t.value) for t in toks[:-1]] == [
+        (SYM, "("), (FLOAT, 0.5), (SYM, ")")]
+
+
+def test_range_vs_float():
+    toks = tokenize("[*1..3]")
+    assert [(t.kind, t.text) for t in toks[:-1]] == [
+        (SYM, "["), (SYM, "*"), (INT, "1"), (SYM, ".."), (INT, "3"), (SYM, "]")]
+
+
+def test_property_access_not_float():
+    toks = tokenize("a.5")  # not valid cypher but lexer must not merge
+    assert toks[0].kind == IDENT
+
+
+def test_strings_and_escapes():
+    toks = tokenize(r"'it\'s' " + '"two\\nlines"')
+    assert toks[0].value == "it's"
+    assert toks[1].value == "two\nlines"
+
+
+def test_comments_stripped():
+    assert kinds("a // line\n b /* block */ c") == [
+        (IDENT, "a"), (IDENT, "b"), (IDENT, "c")]
+
+
+def test_multichar_symbols():
+    assert [t.text for t in tokenize("<= >= <> =~ -> <- ..")[:-1]] == [
+        "<=", ">=", "<>", "=~", "->", "<-", ".."]
+
+
+def test_arrows_in_pattern():
+    assert [t.text for t in tokenize("(a)-[r]->(b)")[:-1]] == [
+        "(", "a", ")", "-", "[", "r", "]", "->", "(", "b", ")"]
+
+
+def test_unterminated_string_raises():
+    with pytest.raises(CypherSyntaxError):
+        tokenize("'oops")
+
+
+def test_eof_token():
+    assert tokenize("")[-1].kind == EOF

@@ -1,0 +1,259 @@
+import pytest
+
+from caps_tpu.frontend import ast
+from caps_tpu.frontend.lexer import CypherSyntaxError
+from caps_tpu.frontend.parser import parse_query
+from caps_tpu.frontend.semantic import CypherSemanticError, check_statement
+from caps_tpu.ir import exprs as E
+
+
+def parse_checked(q):
+    stmt = parse_query(q)
+    check_statement(stmt)
+    return stmt
+
+
+def first_match(stmt):
+    return stmt.clauses[0]
+
+
+def test_simple_match_return():
+    q = parse_checked("MATCH (a:Person) RETURN a.name")
+    m, r = q.clauses
+    assert isinstance(m, ast.MatchClause) and not m.optional
+    node = m.pattern.parts[0].elements[0]
+    assert node.var == "a" and node.labels == ("Person",)
+    item = r.body.items[0]
+    assert item.expr == E.Property(E.Var("a"), "name")
+
+
+def test_two_hop_pattern():
+    q = parse_checked(
+        "MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) WHERE a.name = 'Alice' RETURN c.name")
+    m = first_match(q)
+    part = m.pattern.parts[0]
+    assert len(part.nodes) == 3 and len(part.rels) == 2
+    assert part.rels[0].rel_types == ("KNOWS",)
+    assert part.rels[0].direction == ast.Direction.OUTGOING
+    assert m.where == E.Equals(E.Property(E.Var("a"), "name"), E.Lit("Alice"))
+
+
+def test_directions():
+    q = parse_checked("MATCH (a)<-[r:X]-(b), (c)-[s]-(d), (e)-->(f) RETURN a")
+    parts = first_match(q).pattern.parts
+    assert parts[0].rels[0].direction == ast.Direction.INCOMING
+    assert parts[1].rels[0].direction == ast.Direction.BOTH
+    assert parts[2].rels[0].direction == ast.Direction.OUTGOING
+    assert parts[2].rels[0].var is None
+
+
+def test_var_length():
+    q = parse_checked("MATCH (a)-[r:KNOWS*1..3]->(b) RETURN b")
+    rel = first_match(q).pattern.parts[0].rels[0]
+    assert rel.var_length == (1, 3)
+    q2 = parse_checked("MATCH (a)-[*]->(b) RETURN b")
+    assert first_match(q2).pattern.parts[0].rels[0].var_length == (1, None)
+    q3 = parse_checked("MATCH (a)-[*2]->(b) RETURN b")
+    assert first_match(q3).pattern.parts[0].rels[0].var_length == (2, 2)
+    q4 = parse_checked("MATCH (a)-[*..4]->(b) RETURN b")
+    assert first_match(q4).pattern.parts[0].rels[0].var_length == (1, 4)
+
+
+def test_multiple_rel_types():
+    q = parse_checked("MATCH (a)-[r:KNOWS|LIKES]->(b) RETURN r")
+    assert first_match(q).pattern.parts[0].rels[0].rel_types == ("KNOWS", "LIKES")
+
+
+def test_node_properties_inline():
+    q = parse_checked("MATCH (a:Person {name: 'Alice', age: 23}) RETURN a")
+    node = first_match(q).pattern.parts[0].elements[0]
+    assert node.properties == E.MapLit(("name", "age"), (E.Lit("Alice"), E.Lit(23)))
+
+
+def test_operator_precedence():
+    q = parse_checked("RETURN 1 + 2 * 3 AS x")
+    expr = q.clauses[0].body.items[0].expr
+    assert expr == E.Add(E.Lit(1), E.Multiply(E.Lit(2), E.Lit(3)))
+
+
+def test_boolean_precedence():
+    q = parse_checked("MATCH (n) WHERE n.a = 1 OR n.b = 2 AND NOT n.c = 3 RETURN n")
+    w = first_match(q).where
+    assert isinstance(w, E.Ors)
+    assert isinstance(w.exprs[1], E.Ands)
+    assert isinstance(w.exprs[1].exprs[1], E.Not)
+
+
+def test_comparison_chain_becomes_ands():
+    q = parse_checked("MATCH (n) WHERE 1 < n.x < 10 RETURN n")
+    w = first_match(q).where
+    assert isinstance(w, E.Ands) and len(w.exprs) == 2
+
+
+def test_string_predicates_and_in():
+    q = parse_checked(
+        "MATCH (n) WHERE n.name STARTS WITH 'A' AND n.name ENDS WITH 'e' "
+        "AND n.name CONTAINS 'li' AND n.age IN [1, 2, 3] RETURN n")
+    w = first_match(q).where
+    types = [type(e) for e in w.exprs]
+    assert types == [E.StartsWith, E.EndsWith, E.Contains, E.In]
+
+
+def test_is_null():
+    q = parse_checked("MATCH (n) WHERE n.x IS NULL AND n.y IS NOT NULL RETURN n")
+    w = first_match(q).where
+    assert isinstance(w.exprs[0], E.IsNull)
+    assert isinstance(w.exprs[1], E.IsNotNull)
+
+
+def test_label_predicate_in_where():
+    q = parse_checked("MATCH (n) WHERE n:Person:Admin RETURN n")
+    w = first_match(q).where
+    assert w == E.Ands((E.HasLabel(E.Var("n"), "Person"), E.HasLabel(E.Var("n"), "Admin")))
+
+
+def test_aggregators():
+    q = parse_checked(
+        "MATCH (n) RETURN count(*) AS c, count(DISTINCT n.x) AS d, "
+        "sum(n.a) AS s, collect(n.b) AS l, min(n.c) AS mn")
+    items = q.clauses[1].body.items
+    assert isinstance(items[0].expr, E.CountStar)
+    assert items[1].expr == E.Count(E.Property(E.Var("n"), "x"), True)
+    assert isinstance(items[2].expr, E.Sum)
+    assert isinstance(items[3].expr, E.Collect)
+    assert isinstance(items[4].expr, E.Min)
+
+
+def test_functions():
+    q = parse_checked("MATCH (n)-[r]->(m) RETURN id(n), labels(n), type(r), toUpper(n.name)")
+    items = q.clauses[1].body.items
+    assert items[0].expr == E.Id(E.Var("n"))
+    assert items[1].expr == E.Labels(E.Var("n"))
+    assert items[2].expr == E.Type(E.Var("r"))
+    assert items[3].expr == E.FunctionExpr("toupper", (E.Property(E.Var("n"), "name"),))
+
+
+def test_case_expression():
+    q = parse_checked(
+        "MATCH (n) RETURN CASE WHEN n.age > 18 THEN 'adult' ELSE 'minor' END AS cat")
+    expr = q.clauses[1].body.items[0].expr
+    assert isinstance(expr, E.CaseExpr)
+    assert expr.default == E.Lit("minor")
+    # simple form normalizes to searched form
+    q2 = parse_checked("MATCH (n) RETURN CASE n.x WHEN 1 THEN 'a' END AS v")
+    e2 = q2.clauses[1].body.items[0].expr
+    assert isinstance(e2.conditions[0], E.Equals)
+
+
+def test_with_order_skip_limit_distinct():
+    q = parse_checked(
+        "MATCH (n) WITH DISTINCT n.name AS name ORDER BY name DESC SKIP 1 LIMIT 2 "
+        "WHERE name <> 'Bob' RETURN name")
+    w = q.clauses[1]
+    assert isinstance(w, ast.WithClause)
+    assert w.body.distinct
+    assert not w.body.order_by[0].ascending
+    assert w.body.skip == E.Lit(1) and w.body.limit == E.Lit(2)
+    assert w.where is not None
+
+
+def test_unwind():
+    q = parse_checked("UNWIND [1, 2, 3] AS x RETURN x")
+    u = q.clauses[0]
+    assert isinstance(u, ast.UnwindClause) and u.var == "x"
+
+
+def test_union():
+    q = parse_checked("MATCH (a:A) RETURN a.x AS v UNION MATCH (b:B) RETURN b.y AS v")
+    assert isinstance(q, ast.UnionQuery) and not q.union_all
+    q2 = parse_checked("RETURN 1 AS v UNION ALL RETURN 2 AS v")
+    assert q2.union_all
+
+
+def test_return_star():
+    q = parse_checked("MATCH (n) RETURN *")
+    assert q.clauses[1].body.star
+
+
+def test_parameters():
+    q = parse_checked("MATCH (n) WHERE n.name = $name RETURN n LIMIT $lim")
+    assert first_match(q).where == E.Equals(E.Property(E.Var("n"), "name"), E.Param("name"))
+    assert q.clauses[1].body.limit == E.Param("lim")
+
+
+def test_list_comprehension():
+    q = parse_checked("RETURN [x IN [1,2,3] WHERE x > 1 | x * 2] AS l")
+    expr = q.clauses[0].body.items[0].expr
+    assert isinstance(expr, E.ListComprehension)
+    assert expr.var == "x" and expr.predicate is not None and expr.projection is not None
+
+
+def test_create_clause():
+    q = parse_query("CREATE (a:Person {name: 'Alice'})-[:KNOWS {since: 2020}]->(b:Person)")
+    c = q.clauses[0]
+    assert isinstance(c, ast.CreateClause)
+    assert c.pattern.parts[0].rels[0].properties is not None
+
+
+def test_optional_match():
+    q = parse_checked("MATCH (a) OPTIONAL MATCH (a)-[r]->(b) RETURN a, b")
+    assert q.clauses[1].optional
+
+
+def test_from_graph_and_construct():
+    q = parse_query(
+        "FROM GRAPH fs.products MATCH (p:Product) "
+        "CONSTRUCT ON fs.products CLONE p NEW (p)-[:TAGGED]->(:Tag) RETURN GRAPH")
+    check_statement(q)
+    fg, m, c, rg = q.clauses
+    assert isinstance(fg, ast.FromGraphClause) and fg.qualified_name == "fs.products"
+    assert isinstance(c, ast.ConstructClause)
+    assert c.on_graphs == ("fs.products",)
+    assert c.clones[0].var == "p"
+    assert len(c.news) == 1
+    assert isinstance(rg, ast.ReturnGraphClause)
+
+
+def test_catalog_create_graph():
+    q = parse_query("CATALOG CREATE GRAPH session.snapshot { FROM GRAPH session.g "
+                    "MATCH (n) CONSTRUCT CLONE n RETURN GRAPH }")
+    assert isinstance(q, ast.CatalogCreateGraph)
+    assert q.qualified_name == "session.snapshot"
+
+
+def test_named_path():
+    q = parse_checked("MATCH p = (a)-[:X]->(b) RETURN p")
+    assert first_match(q).pattern.parts[0].path_var == "p"
+
+
+def test_syntax_error_reports_position():
+    with pytest.raises(CypherSyntaxError) as ei:
+        parse_query("MATCH (a RETURN a")
+    assert "line 1" in str(ei.value)
+
+
+def test_semantic_unbound_variable():
+    with pytest.raises(CypherSemanticError):
+        parse_checked("MATCH (a) RETURN b")
+
+
+def test_semantic_with_requires_alias():
+    with pytest.raises(CypherSemanticError):
+        parse_checked("MATCH (a) WITH a.name RETURN 1 AS one")
+
+
+def test_semantic_union_column_mismatch():
+    with pytest.raises(CypherSemanticError):
+        parse_checked("RETURN 1 AS a UNION RETURN 2 AS b")
+
+
+def test_semantic_rebound_rel_var():
+    with pytest.raises(CypherSemanticError):
+        parse_checked("MATCH (a)-[r]->(b) MATCH (c)-[r]->(d) RETURN a")
+
+
+def test_keywords_as_property_keys():
+    q = parse_checked("MATCH (n) RETURN n.from AS f, n.end AS e")
+    items = q.clauses[1].body.items
+    assert items[0].expr == E.Property(E.Var("n"), "from")
+    assert items[1].expr == E.Property(E.Var("n"), "end")
