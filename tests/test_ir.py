@@ -1,0 +1,205 @@
+import pytest
+
+from caps_tpu.frontend.parser import parse_query
+from caps_tpu.ir import exprs as E
+from caps_tpu.ir.blocks import (
+    AggregationBlock, CypherQuery, FilterBlock, MatchBlock, OrderAndSliceBlock,
+    ProjectBlock, ResultBlock, SelectBlock, UnionOfQueries, UnwindBlock,
+)
+from caps_tpu.ir.builder import IRBuildError, IRBuilder
+from caps_tpu.ir.pattern import Connection, Direction
+from caps_tpu.ir.typer import SchemaTyper
+from caps_tpu.okapi.schema import Schema
+from caps_tpu.okapi.types import (
+    CTBoolean, CTFloat, CTInteger, CTList, CTNode, CTRelationship, CTString,
+)
+
+
+def social_schema():
+    return (Schema.empty()
+            .with_node_property_keys(["Person"], {"name": CTString, "age": CTInteger})
+            .with_relationship_property_keys("KNOWS", {"since": CTInteger}))
+
+
+def build(query, schema=None, **params):
+    return IRBuilder(schema or social_schema(), parameters=params).process(
+        parse_query(query))
+
+
+def blocks_of(ir, *types):
+    assert isinstance(ir, CypherQuery)
+    assert [type(b) for b in ir.blocks] == list(types), ir.blocks
+    return ir.blocks
+
+
+def test_simple_match_blocks():
+    ir = build("MATCH (a:Person) RETURN a.name AS name")
+    m, p, r = blocks_of(ir, MatchBlock, ProjectBlock, ResultBlock)
+    assert m.pattern.entity_type("a") == CTNode(["Person"])
+    assert p.items == (("name", E.Property(E.Var("a"), "name")),)
+    assert r.fields == ("name",)
+
+
+def test_two_hop_connections():
+    ir = build("MATCH (a)-[r:KNOWS]->(b)<-[s]-(c) RETURN a")
+    m = ir.blocks[0]
+    conns = m.pattern.connections
+    assert conns[0] == Connection("a", "r", "b", Direction.OUTGOING, ("KNOWS",), None)
+    # incoming hop is normalized to outgoing from c to b
+    assert conns[1].source == "c" and conns[1].target == "b"
+    assert conns[1].direction == Direction.OUTGOING
+
+
+def test_undirected_connection():
+    ir = build("MATCH (a)-[r]-(b) RETURN a")
+    assert ir.blocks[0].pattern.connections[0].direction == Direction.BOTH
+
+
+def test_inline_props_become_predicates():
+    ir = build("MATCH (a:Person {name: 'Alice'}) RETURN a")
+    m = ir.blocks[0]
+    assert E.Equals(E.Property(E.Var("a"), "name"), E.Lit("Alice")) in m.predicates
+
+
+def test_bound_var_relabel_becomes_predicate():
+    ir = build("MATCH (a:Person) MATCH (a:Admin)-[r]->(b) RETURN b")
+    m2 = ir.blocks[1]
+    assert "a" in m2.pattern.bound
+    assert E.HasLabel(E.Var("a"), "Admin") in m2.predicates
+    assert "a" not in m2.pattern.entity_names
+
+
+def test_where_splits_ands():
+    ir = build("MATCH (a:Person) WHERE a.age > 21 AND a.name = 'Bob' RETURN a")
+    assert len(ir.blocks[0].predicates) == 2
+
+
+def test_anonymous_entities_get_fresh_names():
+    ir = build("MATCH (a)-[:KNOWS]->() RETURN a")
+    m = ir.blocks[0]
+    names = m.pattern.entity_names
+    assert len(names) == 3
+    assert sum(n.startswith("__") for n in names) == 2
+
+
+def test_var_length_connection():
+    ir = build("MATCH (a)-[r:KNOWS*1..3]->(b) RETURN a")
+    conn = ir.blocks[0].pattern.connections[0]
+    assert conn.var_length == (1, 3)
+    assert ir.blocks[0].pattern.entity_type("r") == CTList(CTRelationship(["KNOWS"]))
+
+
+def test_aggregation_block_split():
+    ir = build("MATCH (a:Person) RETURN a.name AS name, count(*) AS c")
+    m, agg, r = blocks_of(ir, MatchBlock, AggregationBlock, ResultBlock)
+    assert agg.group == (("name", E.Property(E.Var("a"), "name")),)
+    assert agg.aggregations == (("c", E.CountStar()),)
+
+
+def test_nested_aggregator_gets_post_projection():
+    ir = build("MATCH (a:Person) RETURN count(*) + 1 AS c")
+    m, agg, post, r = blocks_of(ir, MatchBlock, AggregationBlock, ProjectBlock,
+                                ResultBlock)
+    (aname, aexpr), = agg.aggregations
+    assert aexpr == E.CountStar()
+    assert post.items[0][1] == E.Add(E.Var(aname), E.Lit(1))
+
+
+def test_with_where_becomes_filter():
+    ir = build("MATCH (a:Person) WITH a.age AS age WHERE age > 30 RETURN age")
+    types = [type(b) for b in ir.blocks]
+    assert types == [MatchBlock, ProjectBlock, FilterBlock, ProjectBlock, ResultBlock]
+
+
+def test_order_by_alias():
+    ir = build("MATCH (a:Person) RETURN a.name AS name ORDER BY name DESC LIMIT 5")
+    m, p, o, r = blocks_of(ir, MatchBlock, ProjectBlock, OrderAndSliceBlock,
+                           ResultBlock)
+    assert o.order == ((E.Var("name"), False),)
+    assert o.limit == E.Lit(5)
+
+
+def test_order_by_old_scope_gets_hidden_field():
+    ir = build("MATCH (a:Person) RETURN a.name AS name ORDER BY a.age")
+    m, p, o, s, r = blocks_of(ir, MatchBlock, ProjectBlock, OrderAndSliceBlock,
+                              SelectBlock, ResultBlock)
+    assert len(p.items) == 2  # name + hidden order field
+    hidden = p.items[1][0]
+    assert o.order == ((E.Var(hidden), True),)
+    assert s.fields == ("name",)
+    assert r.fields == ("name",)
+
+
+def test_unwind_block_and_env():
+    ir = build("UNWIND [1, 2, 3] AS x RETURN x + 1 AS y")
+    u = ir.blocks[0]
+    assert isinstance(u, UnwindBlock) and u.var == "x"
+
+
+def test_union_of_queries():
+    ir = build("RETURN 1 AS v UNION ALL RETURN 2 AS v")
+    assert isinstance(ir, UnionOfQueries) and ir.union_all
+
+
+def test_return_star_excludes_anon():
+    ir = build("MATCH (a)-[:KNOWS]->(b) RETURN *")
+    r = ir.blocks[-1]
+    assert r.fields == ("a", "b")
+
+
+def test_rebinding_rel_var_fails():
+    with pytest.raises(Exception):
+        build("MATCH (a)-[r]->(b)-[r]->(c) RETURN a")
+
+
+def test_named_path_unsupported():
+    with pytest.raises(IRBuildError):
+        build("MATCH p = (a)-[:X]->(b) RETURN p")
+
+
+# -- typer ------------------------------------------------------------------
+
+def test_typer_property_types():
+    schema = social_schema()
+    typer = SchemaTyper(schema)
+    env = {"a": CTNode(["Person"]), "r": CTRelationship(["KNOWS"])}
+    assert typer.type_of(E.Property(E.Var("a"), "name"), env) == CTString
+    assert typer.type_of(E.Property(E.Var("a"), "age"), env) == CTInteger
+    assert typer.type_of(E.Property(E.Var("r"), "since"), env) == CTInteger
+    # unknown property types as CTNull
+    from caps_tpu.okapi.types import CTNull
+    assert typer.type_of(E.Property(E.Var("a"), "nope"), env) == CTNull
+
+
+def test_typer_comparison_nullability():
+    typer = SchemaTyper(social_schema())
+    env = {"a": CTNode(["Person"])}
+    t = typer.type_of(E.GreaterThan(E.Property(E.Var("a"), "age"), E.Lit(21)), env)
+    assert t == CTBoolean
+    t2 = typer.type_of(E.Equals(E.Lit(None), E.Lit(1)), env)
+    assert t2 == CTBoolean.nullable
+
+
+def test_typer_arithmetic():
+    typer = SchemaTyper(social_schema())
+    env = {"a": CTNode(["Person"])}
+    assert typer.type_of(E.Add(E.Lit(1), E.Lit(2)), env) == CTInteger
+    assert typer.type_of(E.Add(E.Lit(1), E.Lit(2.0)), env) == CTFloat.join(CTInteger)
+    assert typer.type_of(E.Add(E.Lit("a"), E.Lit("b")), env) == CTString
+
+
+def test_typer_aggregators():
+    typer = SchemaTyper(social_schema())
+    env = {"a": CTNode(["Person"])}
+    assert typer.type_of(E.CountStar(), env) == CTInteger
+    assert typer.type_of(E.Avg(E.Property(E.Var("a"), "age")), env) == CTFloat
+    assert typer.type_of(E.Collect(E.Property(E.Var("a"), "name")), env) == CTList(CTString)
+
+
+def test_typer_functions():
+    typer = SchemaTyper(social_schema())
+    env = {"a": CTNode(["Person"])}
+    assert typer.type_of(E.FunctionExpr("toupper", (E.Property(E.Var("a"), "name"),)),
+                         env) == CTString
+    assert typer.type_of(E.FunctionExpr("size", (E.Lit("abc"),)), env) == CTInteger
+    assert typer.type_of(E.Id(E.Var("a")), env) == CTInteger
