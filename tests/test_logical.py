@@ -1,0 +1,155 @@
+import pytest
+
+from caps_tpu.frontend.parser import parse_query
+from caps_tpu.ir import exprs as E
+from caps_tpu.ir.builder import IRBuilder
+from caps_tpu.ir.pattern import Direction
+from caps_tpu.logical import ops as L
+from caps_tpu.logical.optimizer import LogicalOptimizer
+from caps_tpu.logical.planner import LogicalPlanner, LogicalPlanningError
+from caps_tpu.okapi.schema import Schema
+from caps_tpu.okapi.types import CTInteger, CTNode, CTString
+
+
+def social_schema():
+    return (Schema.empty()
+            .with_node_property_keys(["Person"], {"name": CTString, "age": CTInteger})
+            .with_relationship_property_keys("KNOWS", {"since": CTInteger}))
+
+
+def plan(query, optimize=False, **params):
+    schema = social_schema()
+    ir = IRBuilder(schema, parameters=params).process(parse_query(query))
+    p = LogicalPlanner(schema, parameters=params).process(ir)
+    if optimize:
+        p = LogicalOptimizer().process(p)
+    return p
+
+
+def chain(plan_):
+    """Linearize a single-input op chain from root down."""
+    out = []
+    op = plan_.root
+    while op is not None:
+        out.append(op)
+        kids = [c for c in op.children if isinstance(c, L.LogicalOperator)]
+        op = kids[0] if kids else None
+    return out
+
+
+def test_single_scan_plan():
+    p = plan("MATCH (a:Person) RETURN a.name AS name")
+    ops = chain(p)
+    assert [type(o) for o in ops] == [L.Select, L.Project, L.NodeScan, L.Start]
+    scan = ops[2]
+    assert scan.var == "a" and scan.labels == frozenset({"Person"})
+    assert p.result_fields == ("name",)
+
+
+def test_expand_plan():
+    p = plan("MATCH (a:Person)-[r:KNOWS]->(b:Person) RETURN b.name AS n")
+    ops = chain(p)
+    expand = next(o for o in ops if isinstance(o, L.Expand))
+    assert expand.source == "a" and expand.target == "b"
+    assert expand.rel_types == ("KNOWS",)
+    assert expand.direction == Direction.OUTGOING
+    assert not expand.into
+    assert ("r", ) [0] in expand.field_names and "b" in expand.field_names
+
+
+def test_expand_into_for_cycle():
+    p = plan("MATCH (a)-[r:KNOWS]->(b)-[s:KNOWS]->(a) RETURN a")
+    expands = [o for o in plan_ops(p) if isinstance(o, L.Expand)]
+    assert len(expands) == 2
+    assert any(e.into for e in expands)
+
+
+def plan_ops(p):
+    return [o for o in p.root.walk() if isinstance(o, L.LogicalOperator)]
+
+
+def test_reverse_expand_when_only_target_bound():
+    # b is scanned first (appears in connection), a reached via incoming.
+    p = plan("MATCH (a)-[r:KNOWS]->(b:Person) WHERE b.age > 30 RETURN a")
+    expands = [o for o in plan_ops(p) if isinstance(o, L.Expand)]
+    assert len(expands) == 1
+    e = expands[0]
+    # planner picks either endpoint first; both orientations are legal
+    assert (e.source, e.target, e.direction) in (
+        ("a", "b", Direction.OUTGOING), ("b", "a", Direction.INCOMING))
+
+
+def test_disconnected_patterns_cartesian():
+    p = plan("MATCH (a:Person), (b:Person) RETURN a, b")
+    assert any(isinstance(o, L.CartesianProduct) for o in plan_ops(p))
+
+
+def test_optional_match():
+    p = plan("MATCH (a:Person) OPTIONAL MATCH (a)-[r:KNOWS]->(b) RETURN a, b")
+    opt = next(o for o in plan_ops(p) if isinstance(o, L.Optional))
+    assert isinstance(opt.lhs, L.NodeScan)
+    assert any(isinstance(o, L.Expand) for o in opt.rhs.walk())
+
+
+def test_var_length_plan():
+    p = plan("MATCH (a)-[rs:KNOWS*1..3]->(b) RETURN b")
+    vle = next(o for o in plan_ops(p) if isinstance(o, L.BoundedVarLengthExpand))
+    assert vle.lower == 1 and vle.upper == 3
+
+
+def test_aggregation_plan():
+    p = plan("MATCH (a:Person) RETURN a.name AS name, count(*) AS c")
+    agg = next(o for o in plan_ops(p) if isinstance(o, L.Aggregate))
+    assert agg.group[0][0] == "name"
+    assert agg.aggregations[0][0] == "c"
+    assert dict(agg.fields)["c"] == CTInteger
+
+
+def test_order_skip_limit_plan():
+    p = plan("MATCH (a:Person) RETURN a.age AS age ORDER BY age DESC SKIP 1 LIMIT 2")
+    types = [type(o) for o in chain(p)]
+    assert types[:4] == [L.Limit, L.Skip, L.OrderBy, L.Select]
+
+
+def test_union_plan():
+    p = plan("RETURN 1 AS v UNION RETURN 2 AS v")
+    assert isinstance(p.root, L.Distinct)
+    assert isinstance(p.root.parent, L.TabularUnionAll)
+
+
+def test_unwind_plan():
+    p = plan("UNWIND [1,2] AS x RETURN x")
+    u = next(o for o in plan_ops(p) if isinstance(o, L.Unwind))
+    assert dict(u.fields)["x"] == CTInteger
+
+
+def test_label_pushdown_into_scan():
+    p = plan("MATCH (a) WHERE a:Person RETURN a", optimize=True)
+    ops = plan_ops(p)
+    assert not any(isinstance(o, L.Filter) for o in ops)
+    scan = next(o for o in ops if isinstance(o, L.NodeScan))
+    assert scan.labels == frozenset({"Person"})
+
+
+def test_filter_pushdown_below_expand():
+    p = plan("MATCH (a:Person)-[r:KNOWS]->(b) WHERE a.age > 30 RETURN b",
+             optimize=True)
+    ops = chain(p)
+    # the filter on a must sit below the expand, right above the scan
+    fi = next(i for i, o in enumerate(ops) if isinstance(o, L.Filter))
+    ei = next(i for i, o in enumerate(ops) if isinstance(o, L.Expand))
+    assert fi > ei  # deeper in the chain == later in list
+
+
+def test_filter_on_rel_stays_above_expand():
+    p = plan("MATCH (a)-[r:KNOWS]->(b) WHERE r.since > 2000 RETURN b",
+             optimize=True)
+    ops = chain(p)
+    fi = next(i for i, o in enumerate(ops) if isinstance(o, L.Filter))
+    ei = next(i for i, o in enumerate(ops) if isinstance(o, L.Expand))
+    assert fi < ei
+
+
+def test_optional_without_binding_fails():
+    with pytest.raises(LogicalPlanningError):
+        plan("OPTIONAL MATCH (a) RETURN a")
