@@ -42,12 +42,12 @@ def local_session(backend: str = "tpu", **kwargs):
     """Create a local Cypher session (analog of ``CAPSSession.local()``).
 
     backend="tpu" returns a :class:`~caps_tpu.backends.tpu.session.TPUCypherSession`;
-    backend="numpy" returns the NumPy oracle session used as the parity
-    reference in tests.
+    backend="local" returns the pure-Python oracle session used as the
+    parity reference in tests.
     """
-    if backend == "numpy":
-        from caps_tpu.backends.numpy.session import NumpyCypherSession
-        return NumpyCypherSession(**kwargs)
+    if backend in ("local", "oracle"):
+        from caps_tpu.backends.local.session import LocalCypherSession
+        return LocalCypherSession(**kwargs)
     if backend == "tpu":
         from caps_tpu.backends.tpu.session import TPUCypherSession
         return TPUCypherSession(**kwargs)

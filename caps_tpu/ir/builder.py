@@ -290,8 +290,20 @@ class _SingleQueryBuilder:
             order_rewritten: List[Tuple[E.Expr, bool]] = []
             for oi in body.order_by:
                 expr = self._resolve_order_expr(oi.expr, visible, defining)
+                # ORDER BY <expr> where <expr> is exactly a projected item's
+                # defining expression sorts by that item (openCypher rule).
+                for name, dexpr in items:
+                    if expr == dexpr:
+                        expr = E.Var(name)
+                        break
                 if self._uses_only(expr, visible):
                     order_rewritten.append((expr, oi.ascending))
+                elif body.distinct:
+                    # With DISTINCT the sort key would join the distinct key
+                    # and change duplicate elimination; openCypher forbids it.
+                    raise IRBuildError(
+                        "with DISTINCT, ORDER BY may only reference "
+                        "projected columns")
                 else:
                     hname = self.fresh("order")
                     project_items.append((hname, expr))
@@ -312,6 +324,10 @@ class _SingleQueryBuilder:
             order_rewritten = []
             for oi in body.order_by:
                 expr = self._resolve_order_expr(oi.expr, visible, defining)
+                for name, dexpr in items:
+                    if expr == dexpr:  # ORDER BY a grouping-key expression
+                        expr = E.Var(name)
+                        break
                 if not self._uses_only(expr, list(self.env)):
                     raise IRBuildError(
                         "ORDER BY after aggregation may only reference "

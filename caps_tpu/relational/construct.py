@@ -1,0 +1,13 @@
+"""CONSTRUCT / RETURN GRAPH planning (multiple-graph queries).
+
+Mirrors the reference's ``ConstructGraphPlanner`` (ref:
+okapi-relational/.../impl/graph/ConstructGraphPlanner.scala —
+reconstructed, mount empty; SURVEY.md §3.4).  Full implementation lands
+with the catalog milestone; see tests/test_multiple_graph.py.
+"""
+from __future__ import annotations
+
+
+def plan_construct(planner, op):
+    raise NotImplementedError(
+        "CONSTRUCT/RETURN GRAPH planning not implemented yet")

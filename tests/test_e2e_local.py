@@ -1,0 +1,170 @@
+"""End-to-end acceptance: full pipeline on the local oracle backend.
+
+The minimum E2E slice from SURVEY.md §7 step 5: the 2-hop friend-of-friend
+MATCH on the SocialNetworkExample data (benchmark config 1) through
+parse → IR → logical → relational → execution.
+"""
+import pytest
+
+from caps_tpu.backends.local.session import LocalCypherSession
+from caps_tpu.okapi.values import CypherNode
+
+from tests.util import bag, social_graph
+
+
+@pytest.fixture()
+def session():
+    return LocalCypherSession.local()
+
+
+@pytest.fixture()
+def graph(session):
+    return social_graph(session)
+
+
+def run(graph, query, **params):
+    return graph.cypher(query, params).records.to_maps()
+
+
+def test_node_scan(graph):
+    rows = run(graph, "MATCH (a:Person) RETURN a.name")
+    assert bag(rows) == [{"a.name": "Alice"}, {"a.name": "Bob"},
+                         {"a.name": "Carol"}]
+
+
+def test_single_hop(graph):
+    rows = run(graph, "MATCH (a:Person)-[:KNOWS]->(b:Person) "
+                      "RETURN a.name AS a, b.name AS b")
+    assert bag(rows) == [{"a": "Alice", "b": "Bob"}, {"a": "Bob", "b": "Carol"}]
+
+
+def test_two_hop_friend_of_friend(graph):
+    # benchmark config 1
+    rows = run(graph,
+               "MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) "
+               "WHERE a.name = 'Alice' RETURN c.name AS foaf")
+    assert rows == [{"foaf": "Carol"}]
+
+
+def test_filter_on_property(graph):
+    rows = run(graph, "MATCH (a:Person) WHERE a.age > 40 RETURN a.name AS n")
+    assert bag(rows) == [{"n": "Bob"}, {"n": "Carol"}]
+
+
+def test_return_entity_materializes_node(graph):
+    rows = run(graph, "MATCH (a:Person) WHERE a.name = 'Alice' RETURN a")
+    assert rows == [{"a": CypherNode(1, ("Person",),
+                                     {"name": "Alice", "age": 23})}]
+    node = rows[0]["a"]
+    assert node.labels == ("Person",)
+    assert node.properties == {"name": "Alice", "age": 23}
+
+
+def test_rel_property_filter(graph):
+    rows = run(graph, "MATCH (a)-[k:KNOWS]->(b) WHERE k.since >= 2017 "
+                      "RETURN a.name AS a, k.since AS since")
+    assert rows == [{"a": "Alice", "since": 2017}]
+
+
+def test_undirected_match(graph):
+    rows = run(graph, "MATCH (a)-[:KNOWS]-(b) WHERE a.name = 'Bob' "
+                      "RETURN b.name AS n")
+    assert bag(rows) == [{"n": "Alice"}, {"n": "Carol"}]
+
+
+def test_incoming_match(graph):
+    rows = run(graph, "MATCH (a)<-[:KNOWS]-(b) WHERE a.name = 'Bob' "
+                      "RETURN b.name AS n")
+    assert rows == [{"n": "Alice"}]
+
+
+def test_aggregation(graph):
+    rows = run(graph, "MATCH (a:Person) RETURN count(*) AS c, sum(a.age) AS s")
+    assert rows == [{"c": 3, "s": 23 + 42 + 1984}]
+
+
+def test_grouped_aggregation(graph):
+    rows = run(graph, "MATCH (a:Person)-[:KNOWS]->(b) "
+                      "RETURN a.name AS n, count(*) AS c")
+    assert bag(rows) == [{"n": "Alice", "c": 1}, {"n": "Bob", "c": 1}]
+
+
+def test_order_by_limit(graph):
+    rows = run(graph, "MATCH (a:Person) RETURN a.name AS n ORDER BY a.age DESC LIMIT 2")
+    assert rows == [{"n": "Carol"}, {"n": "Bob"}]
+
+
+def test_with_pipeline(graph):
+    rows = run(graph,
+               "MATCH (a:Person) WITH a.age AS age WHERE age < 100 "
+               "RETURN age ORDER BY age")
+    assert rows == [{"age": 23}, {"age": 42}]
+
+
+def test_optional_match(graph):
+    rows = run(graph,
+               "MATCH (a:Person) OPTIONAL MATCH (a)-[:KNOWS]->(b) "
+               "RETURN a.name AS a, b.name AS b")
+    assert bag(rows) == [{"a": "Alice", "b": "Bob"},
+                         {"a": "Bob", "b": "Carol"},
+                         {"a": "Carol", "b": None}]
+
+
+def test_unwind(graph):
+    rows = run(graph, "UNWIND [1, 2, 3] AS x RETURN x * 10 AS y")
+    assert rows == [{"y": 10}, {"y": 20}, {"y": 30}]
+
+
+def test_union(graph):
+    rows = run(graph, "MATCH (a:Person) WHERE a.age < 30 RETURN a.name AS n "
+                      "UNION ALL MATCH (a:Person) WHERE a.age > 1000 "
+                      "RETURN a.name AS n")
+    assert bag(rows) == [{"n": "Alice"}, {"n": "Carol"}]
+
+
+def test_parameters(graph):
+    rows = run(graph, "MATCH (a:Person) WHERE a.name = $who RETURN a.age AS age",
+               who="Bob")
+    assert rows == [{"age": 42}]
+
+
+def test_var_length_expand(graph):
+    rows = run(graph, "MATCH (a)-[rs:KNOWS*1..2]->(b) WHERE a.name = 'Alice' "
+                      "RETURN b.name AS n, size(rs) AS hops")
+    assert bag(rows) == [{"n": "Bob", "hops": 1}, {"n": "Carol", "hops": 2}]
+
+
+def test_var_length_materializes_rels(graph):
+    rows = run(graph, "MATCH (a)-[rs:KNOWS*2..2]->(c) RETURN rs")
+    assert len(rows) == 1
+    rels = rows[0]["rs"]
+    assert [r.rel_type for r in rels] == ["KNOWS", "KNOWS"]
+    assert rels[0].start == 1 and rels[1].end == 3
+
+
+def test_distinct(graph):
+    rows = run(graph, "MATCH (a:Person)-[:KNOWS]-(b) RETURN DISTINCT a.name AS n")
+    assert bag(rows) == [{"n": "Alice"}, {"n": "Bob"}, {"n": "Carol"}]
+
+
+def test_cartesian_product(graph):
+    rows = run(graph, "MATCH (a:Person), (b:Person) RETURN count(*) AS c")
+    assert rows == [{"c": 9}]
+
+
+def test_expand_into_cycle(graph):
+    rows = run(graph, "MATCH (a)-[:KNOWS]->(b)-[:KNOWS]->(a) RETURN a.name AS n")
+    assert rows == []
+
+
+def test_functions_in_projection(graph):
+    rows = run(graph, "MATCH (a:Person) WHERE a.name = 'Alice' "
+                      "RETURN toUpper(a.name) AS up, id(a) AS i, labels(a) AS l")
+    assert rows == [{"up": "ALICE", "i": 1, "l": ["Person"]}]
+
+
+def test_explain(graph):
+    result = graph.cypher("MATCH (a:Person) RETURN a.name AS n")
+    text = result.explain()
+    assert "IR" in text and "LOGICAL" in text and "RELATIONAL" in text
+    assert "NodeScan" in text

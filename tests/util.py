@@ -1,0 +1,92 @@
+"""Shared test helpers: build graphs from plain Python data."""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Mapping, Tuple
+
+from caps_tpu.okapi.types import CypherType, from_python, join_all, CTNull
+from caps_tpu.relational.entity_tables import (
+    NodeMapping, NodeTable, RelationshipMapping, RelationshipTable,
+)
+
+
+def _infer_types(rows: List[Mapping[str, Any]]) -> Dict[str, CypherType]:
+    keys = sorted({k for r in rows for k in r})
+    out = {}
+    for k in keys:
+        vals = [r.get(k) for r in rows]
+        t = join_all(from_python(v) for v in vals if v is not None)
+        if any(v is None or k not in r for v, r in zip(vals, rows)):
+            t = t.nullable
+        out[k] = t
+    return out
+
+
+def make_graph(session, nodes: Mapping[Tuple[str, ...], List[dict]],
+               rels: Mapping[str, List[Tuple[int, int, dict]]],
+               start_rel_id: int = 1000):
+    """nodes: {labels-tuple: [{'_id': int, **props}]};
+    rels: {TYPE: [(src, tgt, props)]} — rel ids auto-assigned."""
+    factory = session.table_factory
+    node_tables = []
+    for labels, rows in nodes.items():
+        props = _infer_types([{k: v for k, v in r.items() if k != "_id"}
+                              for r in rows])
+        data = {"_id": [r["_id"] for r in rows]}
+        for k in props:
+            data[k] = [r.get(k) for r in rows]
+        from caps_tpu.okapi.types import CTInteger
+        types = {"_id": CTInteger, **props}
+        table = factory.from_columns(data, types)
+        mapping = NodeMapping.on("_id").with_implied_labels(*labels)
+        for k in props:
+            mapping = mapping.with_property(k)
+        node_tables.append(NodeTable(mapping, table))
+    rel_tables = []
+    rid = start_rel_id
+    for rel_type, edges in rels.items():
+        props = _infer_types([e[2] for e in edges])
+        data = {"_id": [], "_src": [], "_tgt": []}
+        for k in props:
+            data[k] = []
+        for src, tgt, p in edges:
+            data["_id"].append(rid)
+            rid += 1
+            data["_src"].append(src)
+            data["_tgt"].append(tgt)
+            for k in props:
+                data[k].append(p.get(k))
+        from caps_tpu.okapi.types import CTInteger
+        types = {"_id": CTInteger, "_src": CTInteger, "_tgt": CTInteger, **props}
+        table = factory.from_columns(data, types)
+        mapping = RelationshipMapping.on(rel_type)
+        for k in props:
+            mapping = mapping.with_property(k)
+        rel_tables.append(RelationshipTable(mapping, table))
+    return session.create_graph(node_tables, rel_tables)
+
+
+def social_graph(session):
+    """The bundled SocialNetworkExample data (benchmark config 1): Alice,
+    Bob, Carol connected by KNOWS edges."""
+    return make_graph(
+        session,
+        nodes={
+            ("Person",): [
+                {"_id": 1, "name": "Alice", "age": 23},
+                {"_id": 2, "name": "Bob", "age": 42},
+                {"_id": 3, "name": "Carol", "age": 1984},
+            ],
+        },
+        rels={
+            "KNOWS": [
+                (1, 2, {"since": 2017}),
+                (2, 3, {"since": 2016}),
+            ],
+        },
+    )
+
+
+def bag(rows: Iterable[Mapping[str, Any]]):
+    """Multiset of result rows for order-insensitive comparison."""
+    from caps_tpu.testing.bag import Bag
+    return Bag(rows)
