@@ -45,6 +45,8 @@ class LogicalOptimizer:
                 child = pushed
         if not remaining:
             return child
+        if child is op.parent and len(remaining) == len(conjuncts):
+            return op  # nothing changed: preserve sharing for Optional planning
         pred = remaining[0] if len(remaining) == 1 else E.Ands(tuple(remaining))
         return L.Filter(child, pred, fields=child.fields)
 

@@ -35,7 +35,7 @@ class RelationalPlanner:
         self.ambient_graph = ambient_graph
         self.graph_resolver = graph_resolver
         self.current_graph = ambient_graph
-        self._memo: Dict[int, R.RelationalOperator] = {}
+        self._memo: Dict[L.LogicalOperator, R.RelationalOperator] = {}
         self._fresh = 0
 
     def fresh(self, prefix: str) -> str:
@@ -48,11 +48,13 @@ class RelationalPlanner:
     # ------------------------------------------------------------------
 
     def plan_op(self, op: L.LogicalOperator) -> R.RelationalOperator:  # noqa: C901
-        key = id(op)
-        if key in self._memo:
-            return self._memo[key]
+        # Memo keys are the logical ops themselves (frozen dataclasses, so
+        # structural): shared or structurally-identical subtrees plan to one
+        # relational operator, which Optional planning depends on.
+        if op in self._memo:
+            return self._memo[op]
         out = self._plan_op(op)
-        self._memo[key] = out
+        self._memo[op] = out
         return out
 
     def _plan_op(self, op: L.LogicalOperator) -> R.RelationalOperator:  # noqa: C901
@@ -156,9 +158,9 @@ class RelationalPlanner:
         lhs_planned = self.plan_op(lhs)
         rid = self.fresh("rid")
         tagged = R.RowIndexOp(self.context, lhs_planned, rid)
-        self._memo[id(lhs)] = tagged
+        self._memo[lhs] = tagged
         rhs_planned = self.plan_op(rhs)
-        self._memo[id(lhs)] = lhs_planned
+        self._memo[lhs] = lhs_planned
         return tagged, rhs_planned, rid
 
     # -- Expand (SURVEY.md §3.2: the hot path generator) --------------------

@@ -1,0 +1,50 @@
+"""Acceptance-suite fixtures, mirroring the reference's CAPSTestSuite +
+GraphConstructionFixture pattern (SURVEY.md §4): a shared session per
+backend, `init_graph` from CREATE strings, Bag comparison.
+
+The `session` fixture is parametrized by backend so every behaviour suite
+runs against the local oracle AND the TPU backend once it lands.
+"""
+import pytest
+
+from caps_tpu.testing.bag import Bag
+from caps_tpu.testing.factory import create_graph
+
+BACKENDS = ["local", "tpu"]
+
+
+def _make_session(backend):
+    if backend == "local":
+        from caps_tpu.backends.local.session import LocalCypherSession
+        return LocalCypherSession()
+    if backend == "tpu":
+        from caps_tpu.backends.tpu.session import TPUCypherSession
+        return TPUCypherSession()
+    raise ValueError(backend)
+
+
+@pytest.fixture(params=BACKENDS, scope="module")
+def session(request):
+    try:
+        return _make_session(request.param)
+    except (ImportError, ModuleNotFoundError):
+        pytest.skip(f"backend {request.param!r} not available yet")
+
+
+@pytest.fixture()
+def init_graph(session):
+    def make(create_query: str, **params):
+        return create_graph(session, create_query, params)
+    return make
+
+
+@pytest.fixture()
+def run():
+    def _run(graph, query, **params):
+        return graph.cypher(query, params).records.to_maps()
+    return _run
+
+
+@pytest.fixture()
+def bag():
+    return Bag

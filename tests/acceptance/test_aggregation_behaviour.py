@@ -1,0 +1,68 @@
+"""Aggregation behaviour (mirrors the reference's AggregationBehaviour)."""
+
+
+def test_count_star_and_column(init_graph, run):
+    g = init_graph("CREATE ({v: 1}), ({v: 2}), ({w: 3})")
+    assert run(g, "MATCH (n) RETURN count(*) AS c") == [{"c": 3}]
+    # count(expr) skips nulls
+    assert run(g, "MATCH (n) RETURN count(n.v) AS c") == [{"c": 2}]
+
+
+def test_count_distinct(init_graph, run):
+    g = init_graph("CREATE ({v: 1}), ({v: 1}), ({v: 2})")
+    assert run(g, "MATCH (n) RETURN count(DISTINCT n.v) AS c") == [{"c": 2}]
+
+
+def test_sum_avg_min_max(init_graph, run):
+    g = init_graph("CREATE ({v: 1}), ({v: 2}), ({v: 3})")
+    rows = run(g, "MATCH (n) RETURN sum(n.v) AS s, avg(n.v) AS a, "
+                  "min(n.v) AS mn, max(n.v) AS mx")
+    assert rows == [{"s": 6, "a": 2.0, "mn": 1, "mx": 3}]
+
+
+def test_collect(init_graph, run):
+    g = init_graph("CREATE ({v: 1}), ({v: 2}), ({w: 0})")
+    rows = run(g, "MATCH (n) RETURN collect(n.v) AS l")
+    assert sorted(rows[0]["l"]) == [1, 2]  # nulls skipped
+
+
+def test_grouped_aggregation(init_graph, run, bag):
+    g = init_graph("CREATE ({g: 'a', v: 1}), ({g: 'a', v: 2}), ({g: 'b', v: 3})")
+    rows = run(g, "MATCH (n) RETURN n.g AS g, sum(n.v) AS s")
+    assert bag(rows) == [{"g": "a", "s": 3}, {"g": "b", "s": 3}]
+
+
+def test_group_by_entity(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:R]->(), (a)-[:R]->(), (b {v: 2})-[:R]->()")
+    rows = run(g, "MATCH (n)-[:R]->() RETURN n.v AS v, count(*) AS c")
+    assert bag(rows) == [{"v": 1, "c": 2}, {"v": 2, "c": 1}]
+
+
+def test_aggregation_on_empty_match(init_graph, run):
+    g = init_graph("CREATE ({v: 1})")
+    rows = run(g, "MATCH (n:Nope) RETURN count(*) AS c, sum(n.v) AS s, "
+                  "min(n.v) AS mn, collect(n.v) AS l")
+    assert rows == [{"c": 0, "s": 0, "mn": None, "l": []}]
+
+
+def test_aggregation_expression_post_processing(init_graph, run):
+    g = init_graph("CREATE ({v: 1}), ({v: 2})")
+    assert run(g, "MATCH (n) RETURN count(*) * 10 + 1 AS c") == [{"c": 21}]
+
+
+def test_avg_of_empty_is_null(init_graph, run):
+    g = init_graph("CREATE ({v: 1})")
+    assert run(g, "MATCH (n:X) RETURN avg(n.v) AS a") == [{"a": None}]
+
+
+def test_aggregation_then_order(init_graph, run):
+    g = init_graph("CREATE ({g: 'a', v: 1}), ({g: 'b', v: 5}), ({g: 'a', v: 2})")
+    rows = run(g, "MATCH (n) RETURN n.g AS g, sum(n.v) AS s ORDER BY s DESC")
+    assert rows == [{"g": "b", "s": 5}, {"g": "a", "s": 3}]
+
+
+def test_with_aggregation_pipeline(init_graph, run, bag):
+    g = init_graph("CREATE ({g: 'a', v: 1}), ({g: 'a', v: 2}), ({g: 'b', v: 9})")
+    rows = run(g, "MATCH (n) WITH n.g AS g, count(*) AS c WHERE c > 1 "
+                  "RETURN g, c")
+    assert rows == [{"g": "a", "c": 2}]

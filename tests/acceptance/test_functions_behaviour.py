@@ -1,0 +1,84 @@
+"""Functions behaviour (mirrors the reference's FunctionsBehaviour)."""
+
+
+def test_id_labels_type(init_graph, run):
+    g = init_graph("CREATE (:A:B {v: 1})-[:R]->(:C)")
+    rows = run(g, "MATCH (n:A)-[r]->(m) RETURN labels(n) AS l, type(r) AS t, "
+                  "labels(m) AS lm")
+    assert rows == [{"l": ["A", "B"], "t": "R", "lm": ["C"]}]
+
+
+def test_string_functions(init_graph, run):
+    g = init_graph("CREATE ({s: '  Hello World  '})")
+    rows = run(g, "MATCH (n) RETURN toUpper(trim(n.s)) AS up, "
+                  "toLower(trim(n.s)) AS lo, size(trim(n.s)) AS n")
+    assert rows == [{"up": "HELLO WORLD", "lo": "hello world", "n": 11}]
+
+
+def test_substring_split_replace(init_graph, run):
+    g = init_graph("CREATE ({s: 'a,b,c'})")
+    rows = run(g, "MATCH (n) RETURN split(n.s, ',') AS parts, "
+                  "replace(n.s, ',', '-') AS r, substring(n.s, 2, 3) AS sub")
+    assert rows == [{"parts": ["a", "b", "c"], "r": "a-b-c", "sub": "b,c"}]
+
+
+def test_numeric_functions(init_graph, run):
+    g = init_graph("CREATE ({v: -2.5})")
+    rows = run(g, "MATCH (n) RETURN abs(n.v) AS a, sign(n.v) AS s, "
+                  "floor(n.v) AS f, ceil(n.v) AS c, sqrt(4.0) AS q")
+    assert rows == [{"a": 2.5, "s": -1, "f": -3.0, "c": -2.0, "q": 2.0}]
+
+
+def test_conversions(init_graph, run):
+    g = init_graph("CREATE ({v: 42})")
+    rows = run(g, "MATCH (n) RETURN toString(n.v) AS s, toFloat(n.v) AS f, "
+                  "toInteger('17') AS i, toBoolean('true') AS b")
+    assert rows == [{"s": "42", "f": 42.0, "i": 17, "b": True}]
+
+
+def test_coalesce(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({w: 2})")
+    rows = run(g, "MATCH (n) RETURN coalesce(n.v, n.w, -1) AS x")
+    assert bag(rows) == [{"x": 1}, {"x": 2}]
+
+
+def test_list_functions(init_graph, run):
+    g = init_graph("CREATE ({v: 1})")
+    rows = run(g, "RETURN head([1,2,3]) AS h, last([1,2,3]) AS l, "
+                  "tail([1,2,3]) AS t, size([1,2,3]) AS s, "
+                  "range(1, 4) AS r, reverse([1,2]) AS rev")
+    assert rows == [{"h": 1, "l": 3, "t": [2, 3], "s": 3,
+                     "r": [1, 2, 3, 4], "rev": [2, 1]}]
+
+
+def test_list_indexing_and_slicing(init_graph, run):
+    g = init_graph("CREATE ({v: 1})")
+    rows = run(g, "RETURN [10,20,30][1] AS i, [10,20,30][-1] AS neg, "
+                  "[10,20,30][1..] AS s1, [10,20,30][..2] AS s2")
+    assert rows == [{"i": 20, "neg": 30, "s1": [20, 30], "s2": [10, 20]}]
+
+
+def test_list_comprehension(init_graph, run):
+    g = init_graph("CREATE ({v: 1})")
+    rows = run(g, "RETURN [x IN range(1, 5) WHERE x % 2 = 1 | x * 10] AS l")
+    assert rows == [{"l": [10, 30, 50]}]
+
+
+def test_string_concat_and_arith(init_graph, run):
+    g = init_graph("CREATE ({a: 'foo', n: 7})")
+    rows = run(g, "MATCH (x) RETURN x.a + 'bar' AS s, x.n % 3 AS m, "
+                  "2 ^ 3 AS p, x.n / 2 AS d")
+    assert rows == [{"s": "foobar", "m": 1, "p": 8.0, "d": 3}]
+
+
+def test_startnode_endnode(init_graph, run):
+    g = init_graph("CREATE ({v: 1})-[:R]->({v: 2})")
+    rows = run(g, "MATCH (a)-[r]->(b) RETURN id(startNode(r)) = id(a) AS s, "
+                  "id(endNode(r)) = id(b) AS e")
+    assert rows == [{"s": True, "e": True}]
+
+
+def test_keys_and_properties(init_graph, run):
+    g = init_graph("CREATE ({a: 1, b: 'x'})")
+    rows = run(g, "MATCH (n) RETURN keys(n) AS k, properties(n) AS p")
+    assert rows == [{"k": ["a", "b"], "p": {"a": 1, "b": "x"}}]

@@ -1,0 +1,99 @@
+"""Match behaviour (mirrors the reference's MatchBehaviour suite)."""
+
+
+def test_match_all_nodes(init_graph, run, bag):
+    g = init_graph("CREATE (:A {v: 1}), (:B {v: 2}), ({v: 3})")
+    rows = run(g, "MATCH (n) RETURN n.v AS v")
+    assert bag(rows) == [{"v": 1}, {"v": 2}, {"v": 3}]
+
+
+def test_match_by_label(init_graph, run, bag):
+    g = init_graph("CREATE (:A {v: 1}), (:B {v: 2}), (:A:B {v: 3})")
+    assert bag(run(g, "MATCH (n:A) RETURN n.v AS v")) == [{"v": 1}, {"v": 3}]
+    assert bag(run(g, "MATCH (n:A:B) RETURN n.v AS v")) == [{"v": 3}]
+    assert bag(run(g, "MATCH (n:B) RETURN n.v AS v")) == [{"v": 2}, {"v": 3}]
+
+
+def test_match_inline_property(init_graph, run, bag):
+    g = init_graph("CREATE (:P {name: 'x', k: 1}), (:P {name: 'y', k: 2})")
+    assert run(g, "MATCH (n:P {name: 'y'}) RETURN n.k AS k") == [{"k": 2}]
+
+
+def test_single_expand(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:R]->(b {v: 2}), (b)-[:R]->(c {v: 3})")
+    rows = run(g, "MATCH (x)-[:R]->(y) RETURN x.v AS x, y.v AS y")
+    assert bag(rows) == [{"x": 1, "y": 2}, {"x": 2, "y": 3}]
+
+
+def test_triangle_cycle(init_graph, run, bag):
+    g = init_graph(
+        "CREATE (a {v: 1})-[:R]->(b {v: 2}), (b)-[:R]->(c {v: 3}), (c)-[:R]->(a)")
+    rows = run(g, "MATCH (x)-[:R]->(y)-[:R]->(z)-[:R]->(x) RETURN x.v AS v")
+    assert bag(rows) == [{"v": 1}, {"v": 2}, {"v": 3}]
+
+
+def test_diamond_multiple_paths(init_graph, run, bag):
+    g = init_graph(
+        "CREATE (a {v: 0})-[:R]->(b {v: 1}), (a)-[:R]->(c {v: 2}), "
+        "(b)-[:R]->(d {v: 3}), (c)-[:R]->(d)")
+    rows = run(g, "MATCH (x {v: 0})-[:R]->()-[:R]->(z) RETURN z.v AS v")
+    assert bag(rows) == [{"v": 3}, {"v": 3}]
+
+
+def test_rel_type_disjunction(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:X]->(b {v: 2}), (a)-[:Y]->(c {v: 3}), "
+                   "(a)-[:Z]->(d {v: 4})")
+    rows = run(g, "MATCH ({v: 1})-[:X|Y]->(t) RETURN t.v AS v")
+    assert bag(rows) == [{"v": 2}, {"v": 3}]
+
+
+def test_rel_var_binding(init_graph, run, bag):
+    g = init_graph("CREATE (a)-[:R {w: 10}]->(b), (b)-[:R {w: 20}]->(c)")
+    rows = run(g, "MATCH ()-[r:R]->() RETURN r.w AS w, type(r) AS t")
+    assert bag(rows) == [{"w": 10, "t": "R"}, {"w": 20, "t": "R"}]
+
+
+def test_undirected_and_incoming(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:R]->(b {v: 2})")
+    assert bag(run(g, "MATCH (x)-[:R]-(y) RETURN x.v AS x, y.v AS y")) == [
+        {"x": 1, "y": 2}, {"x": 2, "y": 1}]
+    assert run(g, "MATCH (x)<-[:R]-(y) RETURN x.v AS x, y.v AS y") == [
+        {"x": 2, "y": 1}]
+
+
+def test_self_loop_undirected_matches_once_per_orientation(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:R]->(a)")
+    rows = run(g, "MATCH (x)-[:R]-(y) RETURN x.v AS x, y.v AS y")
+    assert bag(rows) == [{"x": 1, "y": 1}]
+
+
+def test_multiple_patterns_same_var(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:X]->(b {v: 2}), (a)-[:Y]->(c {v: 3})")
+    rows = run(g, "MATCH (n)-[:X]->(x) MATCH (n)-[:Y]->(y) "
+                  "RETURN x.v AS x, y.v AS y")
+    assert rows == [{"x": 2, "y": 3}]
+
+
+def test_var_length_star(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:R]->(b {v: 2}), (b)-[:R]->(c {v: 3})")
+    rows = run(g, "MATCH ({v: 1})-[rs:R*]->(t) RETURN t.v AS v, size(rs) AS n")
+    assert bag(rows) == [{"v": 2, "n": 1}, {"v": 3, "n": 2}]
+
+
+def test_var_length_zero_lower_bound(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:R]->(b {v: 2})")
+    rows = run(g, "MATCH (s {v: 1})-[rs:R*0..1]->(t) RETURN t.v AS v, size(rs) AS n")
+    assert bag(rows) == [{"v": 1, "n": 0}, {"v": 2, "n": 1}]
+
+
+def test_var_length_edge_isomorphism(init_graph, run, bag):
+    # one edge: a-b; paths of length 2 would need to reuse it — forbidden
+    g = init_graph("CREATE (a {v: 1})-[:R]->(b {v: 2}), (b)-[:R]->(a)")
+    rows = run(g, "MATCH ({v: 1})-[rs:R*2..2]->(t) RETURN t.v AS v")
+    assert bag(rows) == [{"v": 1}]  # a->b->a uses two distinct edges
+
+
+def test_empty_graph_matches_nothing(init_graph, run):
+    g = init_graph("")
+    assert run(g, "MATCH (n) RETURN n") == []
+    assert run(g, "MATCH (a)-[r]->(b) RETURN a") == []

@@ -1,0 +1,44 @@
+"""OPTIONAL MATCH behaviour (mirrors the reference's OptionalMatchBehaviour)."""
+
+
+def test_optional_null_padding(init_graph, run, bag):
+    g = init_graph("CREATE (a:P {v: 1})-[:R]->(b:P {v: 2}), (:P {v: 3})")
+    rows = run(g, "MATCH (n:P) OPTIONAL MATCH (n)-[:R]->(m) "
+                  "RETURN n.v AS n, m.v AS m")
+    assert bag(rows) == [{"n": 1, "m": 2}, {"n": 2, "m": None},
+                         {"n": 3, "m": None}]
+
+
+def test_optional_preserves_duplicates(init_graph, run, bag):
+    g = init_graph("CREATE (a {v: 1})-[:R]->({w: 1}), (a)-[:R]->({w: 2})")
+    rows = run(g, "MATCH (n {v: 1}) OPTIONAL MATCH (n)-[:R]->(m) "
+                  "RETURN m.w AS w")
+    assert bag(rows) == [{"w": 1}, {"w": 2}]
+
+
+def test_optional_with_predicate_inside(init_graph, run, bag):
+    g = init_graph("CREATE (a:P {v: 1})-[:R {w: 5}]->(b), (c:P {v: 2})-[:R {w: 1}]->(d)")
+    rows = run(g, "MATCH (n:P) OPTIONAL MATCH (n)-[r:R]->(m) WHERE r.w > 3 "
+                  "RETURN n.v AS n, r.w AS w")
+    assert bag(rows) == [{"n": 1, "w": 5}, {"n": 2, "w": None}]
+
+
+def test_optional_match_entity_is_null(init_graph, run, bag):
+    g = init_graph("CREATE (:P {v: 1})")
+    rows = run(g, "MATCH (n:P) OPTIONAL MATCH (n)-[:R]->(m) RETURN m")
+    assert rows == [{"m": None}]
+
+
+def test_chained_optional_matches(init_graph, run, bag):
+    g = init_graph("CREATE (a:P {v: 1})-[:R]->(b {v: 2}), (b)-[:S]->(c {v: 3})")
+    rows = run(g, "MATCH (n:P) OPTIONAL MATCH (n)-[:R]->(m) "
+                  "OPTIONAL MATCH (m)-[:S]->(o) "
+                  "RETURN n.v AS n, m.v AS m, o.v AS o")
+    assert rows == [{"n": 1, "m": 2, "o": 3}]
+
+
+def test_optional_then_aggregate(init_graph, run, bag):
+    g = init_graph("CREATE (:P {v: 1})-[:R]->(), (:P {v: 2})")
+    rows = run(g, "MATCH (n:P) OPTIONAL MATCH (n)-[r:R]->() "
+                  "RETURN n.v AS v, count(r) AS c")
+    assert bag(rows) == [{"v": 1, "c": 1}, {"v": 2, "c": 0}]

@@ -1,0 +1,91 @@
+"""Predicate behaviour: WHERE with 3-valued logic (mirrors the reference's
+PredicateBehaviour)."""
+
+
+def test_comparisons(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({v: 2}), ({v: 3})")
+    assert bag(run(g, "MATCH (n) WHERE n.v > 1 RETURN n.v AS v")) == [
+        {"v": 2}, {"v": 3}]
+    assert bag(run(g, "MATCH (n) WHERE n.v <= 2 RETURN n.v AS v")) == [
+        {"v": 1}, {"v": 2}]
+    assert bag(run(g, "MATCH (n) WHERE n.v <> 2 RETURN n.v AS v")) == [
+        {"v": 1}, {"v": 3}]
+
+
+def test_null_comparisons_drop_rows(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({w: 9})")
+    assert run(g, "MATCH (n) WHERE n.v > 0 RETURN n.v AS v") == [{"v": 1}]
+    assert run(g, "MATCH (n) WHERE n.v = n.v RETURN n.v AS v") == [{"v": 1}]
+
+
+def test_is_null_predicates(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1, name: 'a'}), ({v: 2})")
+    assert run(g, "MATCH (n) WHERE n.name IS NULL RETURN n.v AS v") == [{"v": 2}]
+    assert run(g, "MATCH (n) WHERE n.name IS NOT NULL RETURN n.v AS v") == [{"v": 1}]
+
+
+def test_boolean_connectives(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({v: 2}), ({v: 3}), ({v: 4})")
+    assert bag(run(g, "MATCH (n) WHERE n.v > 1 AND n.v < 4 RETURN n.v AS v")) == [
+        {"v": 2}, {"v": 3}]
+    assert bag(run(g, "MATCH (n) WHERE n.v = 1 OR n.v = 4 RETURN n.v AS v")) == [
+        {"v": 1}, {"v": 4}]
+    assert bag(run(g, "MATCH (n) WHERE NOT n.v = 1 RETURN n.v AS v")) == [
+        {"v": 2}, {"v": 3}, {"v": 4}]
+    assert bag(run(g, "MATCH (n) WHERE n.v = 1 XOR n.v > 3 RETURN n.v AS v")) == [
+        {"v": 1}, {"v": 4}]
+
+
+def test_three_valued_or_with_null(init_graph, run, bag):
+    # null OR true = true — row with missing prop still matches second leg
+    g = init_graph("CREATE ({v: 1}), ({w: 5})")
+    rows = run(g, "MATCH (n) WHERE n.v = 1 OR n.w = 5 RETURN id(n) IS NOT NULL AS ok")
+    assert bag(rows) == [{"ok": True}, {"ok": True}]
+
+
+def test_string_predicates(init_graph, run, bag):
+    g = init_graph("CREATE ({s: 'apple'}), ({s: 'banana'}), ({s: 'apricot'})")
+    assert bag(run(g, "MATCH (n) WHERE n.s STARTS WITH 'ap' RETURN n.s AS s")) == [
+        {"s": "apple"}, {"s": "apricot"}]
+    assert bag(run(g, "MATCH (n) WHERE n.s ENDS WITH 'a' RETURN n.s AS s")) == [
+        {"s": "banana"}]
+    assert bag(run(g, "MATCH (n) WHERE n.s CONTAINS 'an' RETURN n.s AS s")) == [
+        {"s": "banana"}]
+
+
+def test_regex_match(init_graph, run, bag):
+    g = init_graph("CREATE ({s: 'abc1'}), ({s: 'xyz'})")
+    assert run(g, "MATCH (n) WHERE n.s =~ '[a-c]+1' RETURN n.s AS s") == [
+        {"s": "abc1"}]
+
+
+def test_in_list(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({v: 2}), ({v: 5})")
+    assert bag(run(g, "MATCH (n) WHERE n.v IN [1, 5, 9] RETURN n.v AS v")) == [
+        {"v": 1}, {"v": 5}]
+
+
+def test_label_predicate(init_graph, run, bag):
+    g = init_graph("CREATE (:A {v: 1}), (:B {v: 2}), (:A:B {v: 3})")
+    assert bag(run(g, "MATCH (n) WHERE n:A RETURN n.v AS v")) == [
+        {"v": 1}, {"v": 3}]
+    assert bag(run(g, "MATCH (n) WHERE n:A AND NOT n:B RETURN n.v AS v")) == [
+        {"v": 1}]
+
+
+def test_exists_property(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1, x: 0}), ({v: 2})")
+    assert run(g, "MATCH (n) WHERE exists(n.x) RETURN n.v AS v") == [{"v": 1}]
+
+
+def test_predicate_on_rel_property(init_graph, run, bag):
+    g = init_graph("CREATE (a)-[:R {w: 1}]->(b), (a)-[:R {w: 2}]->(c)")
+    assert run(g, "MATCH ()-[r:R]->() WHERE r.w > 1 RETURN r.w AS w") == [
+        {"w": 2}]
+
+
+def test_case_expression(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({v: 10})")
+    rows = run(g, "MATCH (n) RETURN CASE WHEN n.v < 5 THEN 'small' "
+                  "ELSE 'big' END AS size")
+    assert bag(rows) == [{"size": "small"}, {"size": "big"}]

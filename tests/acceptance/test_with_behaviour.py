@@ -1,0 +1,80 @@
+"""WITH / projection / slicing behaviour."""
+
+
+def test_with_narrows_scope(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1, w: 10}), ({v: 2, w: 20})")
+    rows = run(g, "MATCH (n) WITH n.v AS v RETURN v")
+    assert bag(rows) == [{"v": 1}, {"v": 2}]
+
+
+def test_with_distinct(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({v: 1}), ({v: 2})")
+    rows = run(g, "MATCH (n) WITH DISTINCT n.v AS v RETURN v")
+    assert bag(rows) == [{"v": 1}, {"v": 2}]
+
+
+def test_with_entity_passthrough_and_expand(init_graph, run, bag):
+    g = init_graph("CREATE (a:P {v: 1})-[:R]->(b {w: 2})")
+    rows = run(g, "MATCH (n:P) WITH n MATCH (n)-[:R]->(m) RETURN m.w AS w")
+    assert rows == [{"w": 2}]
+
+
+def test_entity_alias(init_graph, run, bag):
+    g = init_graph("CREATE (:P {v: 1})")
+    rows = run(g, "MATCH (n:P) WITH n AS m RETURN m.v AS v, labels(m) AS l")
+    assert rows == [{"v": 1, "l": ["P"]}]
+
+
+def test_order_skip_limit(init_graph, run):
+    g = init_graph("CREATE ({v: 3}), ({v: 1}), ({v: 4}), ({v: 2})")
+    rows = run(g, "MATCH (n) RETURN n.v AS v ORDER BY v SKIP 1 LIMIT 2")
+    assert rows == [{"v": 2}, {"v": 3}]
+
+
+def test_order_desc_with_nulls(init_graph, run):
+    g = init_graph("CREATE ({v: 1}), ({w: 0}), ({v: 2})")
+    rows = run(g, "MATCH (n) RETURN n.v AS v ORDER BY v DESC")
+    assert rows == [{"v": None}, {"v": 2}, {"v": 1}]
+    rows2 = run(g, "MATCH (n) RETURN n.v AS v ORDER BY v ASC")
+    assert rows2 == [{"v": 1}, {"v": 2}, {"v": None}]
+
+
+def test_order_by_two_keys(init_graph, run):
+    g = init_graph("CREATE ({a: 1, b: 2}), ({a: 1, b: 1}), ({a: 0, b: 9})")
+    rows = run(g, "MATCH (n) RETURN n.a AS a, n.b AS b ORDER BY a, b DESC")
+    assert rows == [{"a": 0, "b": 9}, {"a": 1, "b": 2}, {"a": 1, "b": 1}]
+
+
+def test_unwind_from_collect(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({v: 2})")
+    rows = run(g, "MATCH (n) WITH collect(n.v) AS vs UNWIND vs AS v "
+                  "RETURN v * 2 AS d")
+    assert bag(rows) == [{"d": 2}, {"d": 4}]
+
+
+def test_unwind_parameter(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1})")
+    rows = run(g, "UNWIND $xs AS x RETURN x + 1 AS y", xs=[1, 2, 3])
+    assert rows == [{"y": 2}, {"y": 3}, {"y": 4}]
+
+
+def test_with_where_filters_projection(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({v: 2}), ({v: 3})")
+    rows = run(g, "MATCH (n) WITH n.v AS v WHERE v % 2 = 1 RETURN v")
+    assert bag(rows) == [{"v": 1}, {"v": 3}]
+
+
+def test_union_distinct_and_all(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 1}), ({v: 2})")
+    rows_all = run(g, "MATCH (n) RETURN n.v AS v UNION ALL MATCH (n) RETURN n.v AS v")
+    assert len(rows_all) == 4
+    rows_dist = run(g, "MATCH (n) RETURN n.v AS v UNION MATCH (n) RETURN n.v AS v")
+    assert bag(rows_dist) == [{"v": 1}, {"v": 2}]
+
+
+def test_return_star(init_graph, run, bag):
+    g = init_graph("CREATE (:A {v: 1})-[:R]->(:B {w: 2})")
+    rows = run(g, "MATCH (a:A)-[:R]->(b:B) RETURN *")
+    assert len(rows) == 1
+    assert rows[0]["a"].properties == {"v": 1}
+    assert rows[0]["b"].properties == {"w": 2}
