@@ -1,0 +1,166 @@
+"""Device column representation and CypherType → dtype mapping.
+
+A column is (data, valid): a device array padded to the table's bucketed
+capacity plus a validity mask (False = Cypher null).  Row padding beyond
+the table's live row count is tracked table-level, not per column.
+
+Kinds:
+    id     int32   entity ids (dense, < 2^31 — the MXU/VPU-friendly width)
+    int    int64   CTInteger properties (Cypher integers are 64-bit)
+    float  float64 CTFloat/CTNumber
+    bool   bool_
+    str    int32   dictionary codes into the session StringPool
+    list   int32 2D (capacity, max_len) + lens — relationship-id lists
+    object —       host-only values; forces local fallback
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from caps_tpu.okapi.types import (
+    CTBoolean, CTFloat, CTInteger, CTNumber, CTString, CypherType, _CTList,
+    _CTNode, _CTRelationship,
+)
+
+jax.config.update("jax_enable_x64", True)
+
+_DTYPES = {
+    "id": jnp.int32,
+    "int": jnp.int64,
+    "float": jnp.float64,
+    "bool": jnp.bool_,
+    "str": jnp.int32,
+    "list": jnp.int32,
+}
+
+
+def kind_for(ctype: CypherType) -> str:
+    m = ctype.material
+    if isinstance(m, (_CTNode, _CTRelationship)):
+        return "id"
+    if isinstance(m, _CTList):
+        inner = m.inner.material if m.inner is not None else None
+        if isinstance(inner, _CTRelationship):
+            return "list"
+        return "object"
+    if m == CTInteger:
+        return "int"
+    if m in (CTFloat, CTNumber):
+        return "float"
+    if m == CTBoolean:
+        return "bool"
+    if m == CTString:
+        return "str"
+    return "object"
+
+
+@dataclasses.dataclass
+class Column:
+    kind: str
+    data: jnp.ndarray            # (capacity,) or (capacity, max_len)
+    valid: jnp.ndarray           # bool (capacity,)
+    ctype: CypherType
+    lens: Optional[jnp.ndarray] = None  # int32 (capacity,) for kind="list"
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def astype_kind(self, kind: str) -> "Column":
+        if kind == self.kind:
+            return self
+        return Column(kind, self.data.astype(_DTYPES[kind]), self.valid,
+                      self.ctype, self.lens)
+
+
+def make_column(values: List[Any], ctype: CypherType, capacity: int,
+                pool) -> Column:
+    """Host values → device column (padded to capacity)."""
+    kind = kind_for(ctype)
+    n = len(values)
+    valid_np = np.zeros(capacity, dtype=bool)
+    if kind == "object":
+        raise ValueError(f"type {ctype!r} has no device representation")
+    if kind == "list":
+        max_len = max((len(v) for v in values if v is not None), default=0)
+        data_np = np.zeros((capacity, max(1, max_len)), dtype=np.int32)
+        lens_np = np.zeros(capacity, dtype=np.int32)
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            valid_np[i] = True
+            lens_np[i] = len(v)
+            for j, x in enumerate(v):
+                data_np[i, j] = int(x if not hasattr(x, "id") else x.id)
+        return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np),
+                      ctype, jnp.asarray(lens_np))
+    dtype = _DTYPES[kind]
+    data_np = np.zeros(capacity, dtype=np.dtype(dtype))
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        valid_np[i] = True
+        if kind == "str":
+            data_np[i] = pool.encode(v)
+        elif kind == "bool":
+            data_np[i] = bool(v)
+        elif kind == "id":
+            iv = int(v)
+            if not (-2**31 < iv < 2**31):
+                raise ValueError(f"entity id {iv} exceeds int32 (ingest "
+                                 "should densify ids)")
+            data_np[i] = iv
+        elif kind == "float":
+            data_np[i] = float(v)
+        else:
+            data_np[i] = int(v)
+    return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np), ctype)
+
+
+def column_to_host(col: Column, n: int, pool) -> List[Any]:
+    """Device column → host Python values (None for null)."""
+    valid = np.asarray(col.valid[:n])
+    if col.kind == "list":
+        data = np.asarray(col.data[:n])
+        lens = np.asarray(col.lens[:n])
+        return [list(map(int, data[i, :lens[i]])) if valid[i] else None
+                for i in range(n)]
+    data = np.asarray(col.data[:n])
+    out: List[Any] = []
+    for i in range(n):
+        if not valid[i]:
+            out.append(None)
+        elif col.kind == "str":
+            out.append(pool.decode(int(data[i])))
+        elif col.kind == "bool":
+            out.append(bool(data[i]))
+        elif col.kind == "float":
+            out.append(float(data[i]))
+        else:
+            out.append(int(data[i]))
+    return out
+
+
+def literal_column(value: Any, ctype: CypherType, capacity: int,
+                   pool) -> Column:
+    kind = kind_for(ctype)
+    if kind == "object":
+        raise ValueError(f"type {ctype!r} has no device representation")
+    if value is None:
+        if kind == "list":
+            return Column(kind, jnp.zeros((capacity, 1), jnp.int32),
+                          jnp.zeros(capacity, bool), ctype,
+                          jnp.zeros(capacity, jnp.int32))
+        return Column(kind, jnp.zeros(capacity, _DTYPES[kind]),
+                      jnp.zeros(capacity, bool), ctype)
+    if kind == "str":
+        value = pool.encode(value)
+    if kind == "list":
+        raise ValueError("literal list columns are not supported")
+    data = jnp.full(capacity, value, _DTYPES[kind])
+    return Column(kind, data, jnp.ones(capacity, bool), ctype)

@@ -1,0 +1,103 @@
+"""Session-global string dictionary.
+
+The device never sees a string (SURVEY.md §7 architecture stance): every
+string value is encoded host-side to an int32 code.  Equality and hashing
+work directly on codes.  Ordering uses a lazily-built *rank* array
+(code -> rank of the string in sorted pool order) shipped to the device, so
+ORDER BY / < / > on strings stay on-device.  String predicates with literal
+arguments (STARTS WITH 'A', CONTAINS 'x', =~ regex) compile to boolean
+lookup tables over the pool, applied as a gather.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+NULL_CODE = -1
+
+
+class StringPool:
+    def __init__(self):
+        self._strings: List[str] = []
+        self._codes: Dict[str, int] = {}
+        self._rank_version = -1
+        self._rank: Optional[np.ndarray] = None
+        # cache of unary string->string function LUTs, keyed by (fn_name, version)
+        self._fn_luts: Dict[tuple, np.ndarray] = {}
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    @property
+    def version(self) -> int:
+        return len(self._strings)
+
+    def encode(self, s: Optional[str]) -> int:
+        if s is None:
+            return NULL_CODE
+        code = self._codes.get(s)
+        if code is None:
+            code = len(self._strings)
+            self._codes[s] = code
+            self._strings.append(s)
+        return code
+
+    def encode_many(self, values) -> np.ndarray:
+        return np.array([self.encode(v) for v in values], dtype=np.int32)
+
+    def decode(self, code: int) -> Optional[str]:
+        if code < 0:
+            return None
+        return self._strings[code]
+
+    def decode_many(self, codes) -> List[Optional[str]]:
+        return [self.decode(int(c)) for c in codes]
+
+    # -- ordering -----------------------------------------------------------
+
+    def rank_array(self) -> np.ndarray:
+        """rank[code] orders codes like their strings; rebuilt when the pool
+        has grown since the last build."""
+        if self._rank_version != self.version:
+            order = np.argsort(np.array(self._strings, dtype=object), kind="stable") \
+                if self._strings else np.zeros(0, dtype=np.int64)
+            rank = np.empty(len(self._strings), dtype=np.int32)
+            rank[order] = np.arange(len(self._strings), dtype=np.int32)
+            self._rank = rank
+            self._rank_version = self.version
+            self._fn_luts.clear()
+        return self._rank
+
+    # -- predicate / function lookup tables ---------------------------------
+
+    def predicate_lut(self, fn: Callable[[str], bool]) -> np.ndarray:
+        """Boolean table over all pool strings: lut[code] = fn(string)."""
+        return np.array([bool(fn(s)) for s in self._strings], dtype=bool) \
+            if self._strings else np.zeros(0, dtype=bool)
+
+    def starts_with_lut(self, prefix: str) -> np.ndarray:
+        return self.predicate_lut(lambda s: s.startswith(prefix))
+
+    def ends_with_lut(self, suffix: str) -> np.ndarray:
+        return self.predicate_lut(lambda s: s.endswith(suffix))
+
+    def contains_lut(self, sub: str) -> np.ndarray:
+        return self.predicate_lut(lambda s: sub in s)
+
+    def regex_lut(self, pattern: str) -> np.ndarray:
+        rx = re.compile(pattern)
+        return self.predicate_lut(lambda s: rx.fullmatch(s) is not None)
+
+    def map_lut(self, name: str, fn: Callable[[str], str]) -> np.ndarray:
+        """int32 table mapping each code to the code of fn(string); new
+        strings are added to the pool.  Cached per (name, pool version)."""
+        key = (name, self.version)
+        if key not in self._fn_luts:
+            size = len(self._strings)
+            out = np.empty(size, dtype=np.int32)
+            for code in range(size):
+                out[code] = self.encode(fn(self._strings[code]))
+            self._fn_luts[key] = out
+        return self._fn_luts[key]

@@ -1,0 +1,32 @@
+"""TPUCypherSession — the user-facing session for the TPU backend.
+
+Mirrors the reference's ``CAPSSession``/``CAPSSessionImpl`` (ref:
+spark-cypher/.../api/CAPSSession.scala — reconstructed, mount empty;
+SURVEY.md §2): the planning stack is untouched; only the Table factory is
+device-backed.  Exposes the backend's fallback counter so benchmarks can
+assert the hot path stayed on-device.
+"""
+from __future__ import annotations
+
+from caps_tpu.backends.tpu.table import DeviceBackend, DeviceTableFactory
+from caps_tpu.okapi.config import DEFAULT_CONFIG
+from caps_tpu.relational.session import RelationalCypherSession
+
+
+class TPUCypherSession(RelationalCypherSession):
+    def __init__(self, config=None):
+        super().__init__(config)
+        self.backend = DeviceBackend(self.config)
+        self._factory = DeviceTableFactory(self.backend)
+
+    @property
+    def table_factory(self) -> DeviceTableFactory:
+        return self._factory
+
+    @property
+    def fallback_count(self) -> int:
+        return self.backend.fallbacks
+
+    @staticmethod
+    def local(**kwargs) -> "TPUCypherSession":
+        return TPUCypherSession(**kwargs)

@@ -1,0 +1,622 @@
+"""DeviceTable: the Table SPI over bucketed device columns.
+
+The TPU counterpart of the reference's ``SparkTable.DataFrameTable`` (ref:
+spark-cypher/.../impl/table/SparkTable.scala — reconstructed, mount empty;
+SURVEY.md §2): filter = mask + compact, join = sort-merge + segmented
+expansion, aggregate = sort + segment reductions, orderBy = multi-key
+lexicographic lax.sort — all shape-static and jit-cached per bucket.
+
+Operators without a device path yet (collect aggregation, DISTINCT
+aggregates, collection-valued expressions, …) raise
+:class:`UnsupportedOnDevice`; the table then converts to the local oracle
+backend and continues there.  Fallbacks are counted on the backend object
+so benchmarks can assert the hot path stayed on-device.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from caps_tpu.backends.local.table import LocalTable, LocalTableFactory
+from caps_tpu.backends.tpu import kernels as K
+from caps_tpu.backends.tpu.column import (
+    Column, column_to_host, kind_for, literal_column, make_column,
+)
+from caps_tpu.backends.tpu.expr import DeviceExprCompiler, UnsupportedOnDevice
+from caps_tpu.backends.tpu.pool import StringPool
+from caps_tpu.ir.exprs import Expr
+from caps_tpu.okapi.config import EngineConfig
+from caps_tpu.okapi.types import CTBoolean, CTInteger, CypherType
+from caps_tpu.relational.header import RecordHeader
+from caps_tpu.relational.table import AggSpec, Table, TableFactory
+
+
+class DeviceBackend:
+    """Shared per-session state: string pool, config, fallback counter."""
+
+    def __init__(self, config: EngineConfig):
+        self.pool = StringPool()
+        self.config = config
+        self.fallbacks = 0
+        self.fallback_reasons: List[str] = []
+
+    def bucket(self, n: int) -> int:
+        return max(1, self.config.bucket_for(n))
+
+
+class DeviceTable(Table):
+    def __init__(self, backend: DeviceBackend,
+                 columns: Optional[Dict[str, Column]] = None, n: int = 0,
+                 local: Optional[LocalTable] = None):
+        self.backend = backend
+        self._cols: Dict[str, Column] = dict(columns or {})
+        self._n = n
+        self._local = local  # non-None → host-fallback mode
+
+    # -- mode handling -------------------------------------------------
+
+    @property
+    def is_local(self) -> bool:
+        return self._local is not None
+
+    def to_local(self) -> LocalTable:
+        if self._local is not None:
+            return self._local
+        data = {c: column_to_host(col, self._n, self.backend.pool)
+                for c, col in self._cols.items()}
+        types = {c: col.ctype for c, col in self._cols.items()}
+        return LocalTable(tuple(self._cols.keys()), data, types,
+                          size=self._n)
+
+    def _fallback(self, reason: str) -> "DeviceTable":
+        self.backend.fallbacks += 1
+        self.backend.fallback_reasons.append(reason)
+        return DeviceTable(self.backend, local=self.to_local())
+
+    def _wrap_local(self, local: LocalTable) -> "DeviceTable":
+        return DeviceTable(self.backend, local=local)
+
+    def _coerce_local(self, other: Table) -> LocalTable:
+        if isinstance(other, DeviceTable):
+            return other.to_local()
+        assert isinstance(other, LocalTable)
+        return other
+
+    @property
+    def capacity(self) -> int:
+        if self._cols:
+            return next(iter(self._cols.values())).capacity
+        return self.backend.bucket(self._n)
+
+    @property
+    def row_ok(self) -> jnp.ndarray:
+        return K.row_mask(self.capacity, self._n)
+
+    # -- shape ----------------------------------------------------------
+
+    @property
+    def columns(self) -> Tuple[str, ...]:
+        if self._local is not None:
+            return self._local.columns
+        return tuple(self._cols.keys())
+
+    @property
+    def size(self) -> int:
+        if self._local is not None:
+            return self._local.size
+        return self._n
+
+    def column_type(self, col: str) -> CypherType:
+        if self._local is not None:
+            return self._local.column_type(col)
+        return self._cols[col].ctype
+
+    # -- column ops ------------------------------------------------------
+
+    def select(self, cols: Sequence[str]) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.select(cols))
+        missing = [c for c in cols if c not in self._cols]
+        if missing:
+            raise KeyError(f"missing columns {missing}; have {self.columns}")
+        return DeviceTable(self.backend, {c: self._cols[c] for c in cols},
+                           self._n)
+
+    def rename(self, mapping: Mapping[str, str]) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.rename(mapping))
+        out = {mapping.get(c, c): col for c, col in self._cols.items()}
+        if len(out) != len(self._cols):
+            raise ValueError(f"rename collision: {mapping}")
+        return DeviceTable(self.backend, out, self._n)
+
+    def copy_column(self, src: str, dst: str) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.copy_column(src, dst))
+        out = dict(self._cols)
+        out[dst] = self._cols[src]
+        return DeviceTable(self.backend, out, self._n)
+
+    def with_literal_column(self, name, value, ctype) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(
+                self._local.with_literal_column(name, value, ctype))
+        try:
+            col = literal_column(value, ctype, self.capacity, self.backend.pool)
+        except ValueError as ex:
+            return self._fallback(str(ex)).with_literal_column(
+                name, value, ctype)
+        out = dict(self._cols)
+        out[name] = col
+        return DeviceTable(self.backend, out, self._n)
+
+    def with_row_index(self, name: str) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.with_row_index(name))
+        col = Column("int", jnp.arange(self.capacity, dtype=jnp.int64),
+                     jnp.ones(self.capacity, bool), CTInteger)
+        out = dict(self._cols)
+        out[name] = col
+        return DeviceTable(self.backend, out, self._n)
+
+    def with_column(self, name, expr: Expr, header: RecordHeader,
+                    parameters, ctype) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.with_column(
+                name, expr, header, parameters, ctype))
+        try:
+            compiler = DeviceExprCompiler(self._cols, self.capacity, header,
+                                          parameters, self.backend.pool,
+                                          self.row_ok)
+            col = compiler.compile(expr)
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).with_column(
+                name, expr, header, parameters, ctype)
+        out = dict(self._cols)
+        out[name] = col
+        return DeviceTable(self.backend, out, self._n)
+
+    # -- row ops ---------------------------------------------------------
+
+    def filter(self, expr: Expr, header: RecordHeader,
+               parameters) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.filter(expr, header, parameters))
+        try:
+            compiler = DeviceExprCompiler(self._cols, self.capacity, header,
+                                          parameters, self.backend.pool,
+                                          self.row_ok)
+            pred = compiler.compile(expr)
+            if pred.kind != "bool":
+                raise UnsupportedOnDevice("filter predicate is not boolean")
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).filter(expr, header, parameters)
+        mask = pred.data & pred.valid & self.row_ok
+        return self._compact(mask)
+
+    def _compact(self, mask: jnp.ndarray) -> "DeviceTable":
+        new_n = int(K.mask_count(mask))
+        out_cap = self.backend.bucket(new_n)
+        idx, _ = K.compact_indices(mask, out_cap)
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+
+    def join(self, other: Table, how: str,
+             pairs: Sequence[Tuple[str, str]]) -> "DeviceTable":
+        if self._local is not None or (isinstance(other, DeviceTable)
+                                       and other.is_local):
+            return self._wrap_local(self.to_local().join(
+                self._coerce_local(other), how, pairs))
+        assert isinstance(other, DeviceTable)
+        shared = set(self.columns) & set(other.columns)
+        if shared:
+            raise ValueError(f"join column collision: {shared}")
+        try:
+            if how == "cross":
+                return self._cross_join(other)
+            return self._sort_merge_join(other, how, pairs)
+        except UnsupportedOnDevice as ex:
+            return self._wrap_local(self.to_local().join(
+                other.to_local(), how, pairs))
+
+    def _join_key(self, col: Column) -> jnp.ndarray:
+        if col.kind in ("id", "int", "str", "bool"):
+            return col.data.astype(jnp.int64)
+        raise UnsupportedOnDevice(f"join key of kind {col.kind}")
+
+    def _sort_merge_join(self, other: "DeviceTable", how: str,
+                         pairs: Sequence[Tuple[str, str]]) -> "DeviceTable":
+        lc, rc = pairs[0]
+        lcol, rcol = self._cols[lc], other._cols[rc]
+        l_ok = lcol.valid & self.row_ok
+        r_ok = rcol.valid & other.row_ok
+        counts, lo, perm = K.join_count(self._join_key(lcol), l_ok,
+                                        self._join_key(rcol), r_ok)
+        left_join = how == "left"
+        total = int(K.join_total(counts, l_ok, left_join))
+        out_cap = self.backend.bucket(total)
+        l_idx, r_idx, out_valid, r_matched, _ = K.join_expand(
+            counts, lo, perm, l_ok, out_cap, left_join)
+        out_cols = _gather_cols(self._cols, l_idx)
+        right = _gather_cols(other._cols, r_idx)
+        for c, col in right.items():
+            out_cols[c] = Column(col.kind, col.data, col.valid & r_matched,
+                                 col.ctype, col.lens)
+        out = DeviceTable(self.backend, out_cols, total)
+        # Extra equality pairs: post-filter (first pair drove the merge).
+        for lc2, rc2 in pairs[1:]:
+            a, b = out._cols[lc2], out._cols[rc2]
+            if a.kind == "float" or b.kind == "float":
+                raise UnsupportedOnDevice("float join key")
+            eq = (a.data.astype(jnp.int64) == b.data.astype(jnp.int64)) \
+                & a.valid & b.valid
+            if left_join:
+                # unmatched left rows keep their single null-extended row
+                keep = eq | ~out._cols[rc2].valid
+            else:
+                keep = eq
+            out = out._compact(keep & out.row_ok)
+        return out
+
+    def _cross_join(self, other: "DeviceTable") -> "DeviceTable":
+        total = self._n * other._n
+        out_cap = self.backend.bucket(total)
+        counts = jnp.where(self.row_ok, other._n, 0)
+        offsets = jnp.cumsum(counts)
+        t = jnp.arange(out_cap)
+        l_idx = jnp.clip(jnp.searchsorted(offsets, t, side="right"),
+                         0, max(0, self.capacity - 1))
+        seg_start = jnp.where(l_idx > 0, offsets[l_idx - 1], 0)
+        within = (t - seg_start) % max(1, other.capacity)
+        out_cols = _gather_cols(self._cols, l_idx)
+        out_cols.update(_gather_cols(other._cols, within))
+        return DeviceTable(self.backend, out_cols, total)
+
+    def union_all(self, other: Table) -> "DeviceTable":
+        if self._local is not None or (isinstance(other, DeviceTable)
+                                       and other.is_local):
+            return self._wrap_local(self.to_local().union_all(
+                self._coerce_local(other)))
+        assert isinstance(other, DeviceTable)
+        if set(self.columns) != set(other.columns):
+            raise ValueError(f"union column mismatch: {self.columns} vs "
+                             f"{other.columns}")
+        total = self._n + other._n
+        out_cap = self.backend.bucket(total)
+        out: Dict[str, Column] = {}
+        for c in self.columns:
+            a, b = self._cols[c], other._cols[c]
+            if a.kind != b.kind:
+                numeric = {"id", "int", "float"}
+                if a.kind in numeric and b.kind in numeric:
+                    target = "float" if "float" in (a.kind, b.kind) else "int"
+                    a, b = a.astype_kind(target), b.astype_kind(target)
+                else:
+                    return self._fallback(
+                        f"union kind mismatch {a.kind}/{b.kind}").union_all(other)
+            out[c] = _concat_columns(a, self._n, b, other._n, out_cap,
+                                     a.ctype.join(b.ctype))
+        return DeviceTable(self.backend, out, total)
+
+    def distinct(self) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.distinct())
+        try:
+            keys = [(~self.row_ok).astype(jnp.int64)]
+            for col in self._cols.values():
+                keys.extend(_sort_keys(col, ascending=True,
+                                       nulls_last=True, pool=self.backend.pool))
+            perm = K.sort_perm(keys, self.capacity)
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).distinct()
+        sorted_cols = _gather_cols(self._cols, perm)
+        stacked = jnp.stack([k[perm].astype(jnp.float64) for k in keys])
+        change = K.neighbor_change(stacked)
+        keep = change & K.row_mask(self.capacity, self._n)
+        tmp = DeviceTable(self.backend, sorted_cols, self._n)
+        return tmp._compact(keep)
+
+    def order_by(self, items: Sequence[Tuple[str, bool]]) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.order_by(items))
+        try:
+            keys = [(~self.row_ok).astype(jnp.int64)]
+            for col_name, asc in items:
+                col = self._cols[col_name]
+                keys.extend(_sort_keys(col, ascending=asc, nulls_last=asc,
+                                       pool=self.backend.pool))
+            perm = K.sort_perm(keys, self.capacity)
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).order_by(items)
+        return DeviceTable(self.backend, _gather_cols(self._cols, perm),
+                           self._n)
+
+    def skip(self, n: int) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.skip(n))
+        n = max(0, n)
+        new_n = max(0, self._n - n)
+        out_cap = self.backend.bucket(new_n)
+        idx = jnp.arange(out_cap) + n
+        idx = jnp.clip(idx, 0, max(0, self.capacity - 1))
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+
+    def limit(self, n: int) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.limit(n))
+        new_n = min(max(0, n), self._n)
+        out_cap = self.backend.bucket(new_n)
+        idx = jnp.clip(jnp.arange(out_cap), 0, max(0, self.capacity - 1))
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+
+    # -- aggregation ------------------------------------------------------
+
+    def group(self, by: Sequence[str], aggs: Sequence[AggSpec]) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.group(by, aggs))
+        try:
+            return self._group_device(by, aggs)
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).group(by, aggs)
+
+    def _group_device(self, by: Sequence[str],
+                      aggs: Sequence[AggSpec]) -> "DeviceTable":
+        for a in aggs:
+            if a.kind in ("collect", "percentile_cont", "percentile_disc"):
+                raise UnsupportedOnDevice(f"{a.kind} aggregation")
+            if a.distinct:
+                raise UnsupportedOnDevice("DISTINCT aggregation")
+        cap = self.capacity
+        pool = self.backend.pool
+        if by:
+            keys = [(~self.row_ok).astype(jnp.int64)]
+            for c in by:
+                keys.extend(_sort_keys(self._cols[c], True, True, pool))
+            perm = K.sort_perm(keys, cap)
+            sorted_cols = _gather_cols(self._cols, perm)
+            stacked = jnp.stack([k[perm].astype(jnp.float64) for k in keys[1:]])
+            change = K.neighbor_change(stacked) & K.row_mask(cap, self._n)
+            seg_id = jnp.clip(jnp.cumsum(change.astype(jnp.int32)) - 1, 0, None)
+            n_groups = int(K.mask_count(change))
+        else:
+            sorted_cols = dict(self._cols)
+            seg_id = jnp.zeros(cap, jnp.int32)
+            n_groups = 1
+            change = jnp.zeros(cap, bool).at[0].set(True) \
+                if cap > 0 else jnp.zeros(cap, bool)
+        out_cap = self.backend.bucket(n_groups)
+        row_ok_sorted = K.row_mask(cap, self._n)
+        if by:
+            start_idx, _ = K.compact_indices(change, out_cap)
+        else:
+            start_idx = jnp.zeros(out_cap, jnp.int32)
+
+        out: Dict[str, Column] = {}
+        for c in by:
+            col = sorted_cols[c]
+            g = Column(col.kind, col.data[start_idx], col.valid[start_idx],
+                       col.ctype, col.lens[start_idx] if col.lens is not None
+                       else None)
+            out[c] = g
+        num_segments = out_cap
+        for a in aggs:
+            out[a.name] = self._one_agg(a, sorted_cols, seg_id, num_segments,
+                                        row_ok_sorted, n_groups)
+        return DeviceTable(self.backend, out, n_groups)
+
+    def _one_agg(self, a: AggSpec, cols: Dict[str, Column], seg_id,
+                 num_segments: int, row_ok, n_groups: int) -> Column:
+        group_live = jnp.arange(num_segments) < n_groups
+        if a.kind == "count_star":
+            data = K.segment_agg(row_ok.astype(jnp.int64), row_ok, seg_id,
+                                 num_segments, "count")
+            return Column("int", data, group_live, CTInteger)
+        col = cols[a.col]
+        ok = col.valid & row_ok
+        if a.kind == "count":
+            data = K.segment_agg(col.data if col.kind != "list" else col.lens,
+                                 ok, seg_id, num_segments, "count")
+            return Column("int", data, group_live, CTInteger)
+        if col.kind == "list":
+            raise UnsupportedOnDevice(f"{a.kind} over list column")
+        if a.kind == "first":
+            data, has = K.segment_agg(col.data, ok, seg_id, num_segments,
+                                      "first")
+            return Column(col.kind, data, has & group_live, col.ctype)
+        if col.kind == "str" and a.kind in ("min", "max"):
+            rank = jnp.asarray(self.backend.pool.rank_array())
+            if rank.shape[0] == 0:
+                return Column("str", jnp.zeros(num_segments, jnp.int32),
+                              jnp.zeros(num_segments, bool), col.ctype)
+            ranks = rank[jnp.clip(col.data, 0, rank.shape[0] - 1)]
+            agg = K.segment_agg(ranks.astype(jnp.int64), ok, seg_id,
+                                num_segments, a.kind)
+            counts = K.segment_agg(ranks, ok, seg_id, num_segments, "count")
+            inv = jnp.argsort(rank).astype(jnp.int32)
+            safe = jnp.clip(agg, 0, inv.shape[0] - 1).astype(jnp.int32)
+            return Column("str", inv[safe], (counts > 0) & group_live,
+                          col.ctype)
+        if col.kind not in ("int", "float", "id", "bool"):
+            raise UnsupportedOnDevice(f"{a.kind} over kind {col.kind}")
+        values = col.data
+        counts = K.segment_agg(values, ok, seg_id, num_segments, "count")
+        if a.kind == "sum":
+            data = K.segment_agg(values, ok, seg_id, num_segments, "sum")
+            return Column(col.kind if col.kind != "bool" else "int",
+                          data, group_live,
+                          a.result_type or col.ctype)
+        if a.kind in ("min", "max"):
+            data = K.segment_agg(values, ok, seg_id, num_segments, a.kind)
+            return Column(col.kind, data, (counts > 0) & group_live, col.ctype)
+        if a.kind == "avg":
+            s = K.segment_agg(values.astype(jnp.float64), ok, seg_id,
+                              num_segments, "sum")
+            data = s / jnp.maximum(counts, 1)
+            from caps_tpu.okapi.types import CTFloat
+            return Column("float", data, (counts > 0) & group_live, CTFloat)
+        if a.kind == "stdev":
+            v = values.astype(jnp.float64)
+            s = K.segment_agg(v, ok, seg_id, num_segments, "sum")
+            s2 = K.segment_agg(v * v, ok, seg_id, num_segments, "sum")
+            nn = jnp.maximum(counts, 1).astype(jnp.float64)
+            var = jnp.maximum(0.0, (s2 - s * s / nn) / jnp.maximum(nn - 1, 1))
+            data = jnp.sqrt(var)
+            data = jnp.where(counts > 1, data, 0.0)
+            from caps_tpu.okapi.types import CTFloat
+            return Column("float", data, (counts > 0) & group_live, CTFloat)
+        raise UnsupportedOnDevice(f"aggregation {a.kind}")
+
+    # -- lists -----------------------------------------------------------
+
+    def explode(self, list_col: str, out_col: str,
+                out_type: CypherType) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.explode(list_col, out_col,
+                                                        out_type))
+        col = self._cols.get(list_col)
+        if col is None or col.kind != "list":
+            return self._fallback("explode of non-list column").explode(
+                list_col, out_col, out_type)
+        ok = col.valid & self.row_ok
+        total = int(jnp.where(ok, col.lens, 0).sum())
+        out_cap = self.backend.bucket(total)
+        row, within, out_valid, _ = K.explode_expand(col.lens, ok, out_cap)
+        rest = {c: v for c, v in self._cols.items() if c != list_col}
+        out_cols = _gather_cols(rest, row)
+        values = col.data[row, jnp.clip(within, 0, col.data.shape[1] - 1)]
+        out_cols[out_col] = Column("id", values, out_valid, out_type)
+        return DeviceTable(self.backend, out_cols, total)
+
+    def pack_list(self, cols: Sequence[str], out_col: str,
+                  out_type: CypherType) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.pack_list(cols, out_col,
+                                                          out_type))
+        cap = self.capacity
+        if not cols:
+            data = jnp.zeros((cap, 1), jnp.int32)
+            lens = jnp.zeros(cap, jnp.int32)
+        else:
+            parts = []
+            valids = []
+            for c in cols:
+                col = self._cols[c]
+                if col.kind not in ("id", "int"):
+                    return self._fallback("pack_list of non-id column"
+                                          ).pack_list(cols, out_col, out_type)
+                parts.append(col.data.astype(jnp.int32))
+                valids.append(col.valid)
+            stacked = jnp.stack(parts, axis=1)          # (cap, k)
+            vstacked = jnp.stack(valids, axis=1)
+            # compact valid entries to the left per-row
+            order = jnp.argsort(~vstacked, axis=1, stable=True)
+            data = jnp.take_along_axis(stacked, order, axis=1)
+            lens = vstacked.sum(axis=1).astype(jnp.int32)
+        out = dict(self._cols)
+        out[out_col] = Column("list", data, jnp.ones(cap, bool), out_type,
+                              lens)
+        return DeviceTable(self.backend, out, self._n)
+
+    # -- materialization --------------------------------------------------
+
+    def column_values(self, col: str) -> List[Any]:
+        if self._local is not None:
+            return self._local.column_values(col)
+        return column_to_host(self._cols[col], self._n, self.backend.pool)
+
+
+def _gather_cols(cols: Dict[str, Column], idx: jnp.ndarray
+                 ) -> Dict[str, Column]:
+    out = {}
+    for c, col in cols.items():
+        if col.kind == "list":
+            out[c] = Column(col.kind, col.data[idx], col.valid[idx],
+                            col.ctype, col.lens[idx])
+        else:
+            out[c] = Column(col.kind, col.data[idx], col.valid[idx], col.ctype)
+    return out
+
+
+def _concat_columns(a: Column, n_a: int, b: Column, n_b: int, out_cap: int,
+                    ctype: CypherType) -> Column:
+    if a.kind == "list":
+        la = a.data.shape[1]
+        lb = b.data.shape[1]
+        width = max(la, lb)
+        da = jnp.pad(a.data[:n_a], ((0, 0), (0, width - la)))
+        db = jnp.pad(b.data[:n_b], ((0, 0), (0, width - lb)))
+        data = jnp.concatenate([da, db], axis=0)
+        data = jnp.pad(data, ((0, out_cap - n_a - n_b), (0, 0)))
+        lens = jnp.concatenate([a.lens[:n_a], b.lens[:n_b]])
+        lens = jnp.pad(lens, (0, out_cap - n_a - n_b))
+        valid = jnp.concatenate([a.valid[:n_a], b.valid[:n_b]])
+        valid = jnp.pad(valid, (0, out_cap - n_a - n_b))
+        return Column("list", data, valid, ctype, lens)
+    data = jnp.concatenate([a.data[:n_a], b.data[:n_b]])
+    data = jnp.pad(data, (0, out_cap - n_a - n_b))
+    valid = jnp.concatenate([a.valid[:n_a], b.valid[:n_b]])
+    valid = jnp.pad(valid, (0, out_cap - n_a - n_b))
+    return Column(a.kind, data, valid, ctype)
+
+
+def _sort_keys(col: Column, ascending: bool, nulls_last: bool,
+               pool) -> List[jnp.ndarray]:
+    """Transform one column into (null_key, data_key) int64/float64 arrays
+    for an ascending lexicographic sort."""
+    if col.kind == "list":
+        raise UnsupportedOnDevice("sorting by list column")
+    null_key = (~col.valid).astype(jnp.int64)
+    if not nulls_last:
+        null_key = -null_key
+    if col.kind == "str":
+        rank = jnp.asarray(pool.rank_array())
+        if rank.shape[0] == 0:
+            data = col.data.astype(jnp.int64)
+        else:
+            data = rank[jnp.clip(col.data, 0, rank.shape[0] - 1)].astype(jnp.int64)
+    elif col.kind == "bool":
+        data = col.data.astype(jnp.int64)
+    elif col.kind == "float":
+        data = col.data
+    else:
+        data = col.data.astype(jnp.int64)
+    if not ascending:
+        data = -data
+    # nulls must not influence the data key
+    data = jnp.where(col.valid, data, 0)
+    return [null_key, data]
+
+
+class DeviceTableFactory(TableFactory):
+    def __init__(self, backend: DeviceBackend):
+        self.backend = backend
+        self._local = LocalTableFactory()
+
+    def from_columns(self, data: Mapping[str, Sequence[Any]],
+                     types: Mapping[str, CypherType]) -> DeviceTable:
+        n = len(next(iter(data.values()))) if data else 0
+        cap = self.backend.bucket(n)
+        cols: Dict[str, Column] = {}
+        for c, values in data.items():
+            ctype = types[c]
+            if kind_for(ctype) == "object":
+                local = self._local.from_columns(data, types)
+                return DeviceTable(self.backend, local=local)
+            cols[c] = make_column(list(values), ctype, cap, self.backend.pool)
+        return DeviceTable(self.backend, cols, n)
+
+    def unit(self) -> DeviceTable:
+        return DeviceTable(self.backend, {}, 1)
+
+    def empty(self, cols: Sequence[str],
+              types: Mapping[str, CypherType]) -> DeviceTable:
+        out: Dict[str, Column] = {}
+        cap = self.backend.bucket(0)
+        for c in cols:
+            ctype = types.get(c, CTInteger)
+            if kind_for(ctype) == "object":
+                local = self._local.empty(cols, types)
+                return DeviceTable(self.backend, local=local)
+            out[c] = make_column([], ctype, cap, self.backend.pool)
+        return DeviceTable(self.backend, out, 0)

@@ -8,10 +8,24 @@ is exercised by bench.py, not the unit suite.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the agent environment pins JAX_PLATFORMS=axon (a tunnel to one
+# real TPU chip) via sitecustomize; unit tests must not touch it.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize registers its PJRT plugin in every interpreter and
+# hooks jax's backend lookup; with the factory registered, the first array
+# creation initializes the tunnel client even under JAX_PLATFORMS=cpu.
+# Deregister it so tests stay purely local.
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # register() pins the config
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

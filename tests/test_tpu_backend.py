@@ -1,0 +1,78 @@
+"""TPU-backend specifics: differential parity vs the oracle and
+zero-fallback guarantees on the hot path."""
+import pytest
+
+from caps_tpu.backends.local.session import LocalCypherSession
+from caps_tpu.backends.tpu.session import TPUCypherSession
+from caps_tpu.testing.bag import Bag
+from caps_tpu.testing.factory import create_graph
+
+SOCIAL = ("CREATE (a:Person {name: 'Alice', age: 23})-"
+          "[:KNOWS {since: 2017}]->(b:Person {name: 'Bob', age: 42}), "
+          "(b)-[:KNOWS {since: 2016}]->(c:Person {name: 'Carol', age: 1984})")
+
+DIFFERENTIAL_QUERIES = [
+    "MATCH (a:Person) RETURN a.name AS n, a.age AS age",
+    "MATCH (a)-[:KNOWS]->(b)-[:KNOWS]->(c) RETURN a.name AS a, c.name AS c",
+    "MATCH (a)-[k:KNOWS]-(b) WHERE k.since > 2016 RETURN a.name AS n",
+    "MATCH (a:Person) WHERE a.name STARTS WITH 'A' OR a.age > 100 "
+    "RETURN a.name AS n",
+    "MATCH (a:Person) RETURN count(*) AS c, sum(a.age) AS s, avg(a.age) AS av,"
+    " min(a.name) AS mn, max(a.name) AS mx",
+    "MATCH (a:Person)-[:KNOWS]->(b) RETURN a.name AS n, count(*) AS c",
+    "MATCH (a:Person) RETURN a.name AS n ORDER BY a.age DESC SKIP 1 LIMIT 1",
+    "MATCH (a:Person) OPTIONAL MATCH (a)-[:KNOWS]->(b) "
+    "RETURN a.name AS a, b.name AS b",
+    "MATCH (a)-[rs:KNOWS*1..2]->(b) RETURN a.name AS a, b.name AS b, "
+    "size(rs) AS hops",
+    "UNWIND [3, 1, 2] AS x RETURN x ORDER BY x",
+    "MATCH (a:Person) WITH DISTINCT a.age > 30 AS old RETURN old",
+    "MATCH (a:Person) WHERE a.name IN ['Alice', 'Carol'] RETURN a.age AS v",
+    "MATCH (a:Person) RETURN toUpper(a.name) AS u, size(a.name) AS s",
+    "MATCH (a:Person), (b:Person) WHERE a.age < b.age "
+    "RETURN a.name AS a, b.name AS b",
+]
+
+
+@pytest.fixture(scope="module")
+def sessions():
+    return LocalCypherSession(), TPUCypherSession()
+
+
+@pytest.fixture(scope="module")
+def graphs(sessions):
+    local, tpu = sessions
+    return create_graph(local, SOCIAL), create_graph(tpu, SOCIAL)
+
+
+@pytest.mark.parametrize("query", DIFFERENTIAL_QUERIES)
+def test_differential_parity(graphs, query):
+    g_local, g_tpu = graphs
+    expected = g_local.cypher(query).records.to_maps()
+    actual = g_tpu.cypher(query).records.to_maps()
+    assert Bag(actual) == Bag(expected), Bag(expected).diff(Bag(actual))
+
+
+def test_hot_path_has_no_fallbacks():
+    session = TPUCypherSession()
+    g = create_graph(session, SOCIAL)
+    before = session.fallback_count
+    g.cypher("MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) "
+             "WHERE a.name = 'Alice' RETURN c.name AS n").records.to_maps()
+    assert session.fallback_count == before, session.backend.fallback_reasons
+
+
+def test_fallback_is_counted_for_collect():
+    session = TPUCypherSession()
+    g = create_graph(session, SOCIAL)
+    before = session.fallback_count
+    rows = g.cypher("MATCH (a:Person) RETURN collect(a.age) AS l").records.to_maps()
+    assert sorted(rows[0]["l"]) == [23, 42, 1984]
+    assert session.fallback_count > before  # collect has no device path yet
+
+
+def test_string_pool_roundtrip():
+    session = TPUCypherSession()
+    g = create_graph(session, "CREATE ({s: 'zeta'}), ({s: 'alpha'}), ({s: 'beta'})")
+    rows = g.cypher("MATCH (n) RETURN n.s AS s ORDER BY s").records.to_maps()
+    assert [r["s"] for r in rows] == ["alpha", "beta", "zeta"]
