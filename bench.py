@@ -1,0 +1,161 @@
+"""Benchmark: 2-hop friend-of-friend MATCH (config 1, scaled) on the TPU
+backend, end-to-end through the full engine pipeline.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+metric: edges-joined/sec through the two expand joins of
+    MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) WHERE a.name = $seed
+    RETURN count(*)
+value: median over warm iterations (planning + device execution).
+vs_baseline: speedup over the in-repo pure-Python oracle backend on the
+    same query (the reference publishes no numbers — BASELINE.md — so the
+    oracle is the only measurable baseline; it is measured on a subsample
+    and scaled per-edge).
+
+If the axon TPU tunnel is unreachable (probed with a timeout), falls back
+to CPU and says so on stderr — the JSON line stays well-formed either way.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+
+def _probe_device(timeout_s: int = 150) -> bool:
+    """Check the axon TPU tunnel from a throwaway process so a wedged
+    tunnel cannot hang the benchmark itself."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _force_cpu():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def build_graph(session, n_people: int, n_edges: int, n_seeds: int, rng):
+    from caps_tpu.okapi.types import CTInteger, CTString
+    from caps_tpu.relational.entity_tables import (
+        NodeMapping, NodeTable, RelationshipMapping, RelationshipTable,
+    )
+    names = [f"p{i}" for i in range(n_people)]
+    for s in rng.choice(n_people, size=n_seeds, replace=False):
+        names[s] = "Alice"
+    ages = rng.randint(18, 90, n_people)
+    src = rng.randint(0, n_people, n_edges)
+    dst = rng.randint(0, n_people, n_edges)
+    f = session.table_factory
+    nt = NodeTable(
+        NodeMapping.on("_id").with_implied_labels("Person")
+        .with_property("name").with_property("age"),
+        f.from_columns(
+            {"_id": list(range(n_people)), "name": names,
+             "age": [int(a) for a in ages]},
+            {"_id": CTInteger, "name": CTString, "age": CTInteger}))
+    rt = RelationshipTable(
+        RelationshipMapping.on("KNOWS"),
+        f.from_columns(
+            {"_id": list(range(n_people, n_people + n_edges)),
+             "_src": [int(x) for x in src], "_tgt": [int(x) for x in dst]},
+            {"_id": CTInteger, "_src": CTInteger, "_tgt": CTInteger}))
+    return session.create_graph([nt], [rt]), src, dst, names
+
+
+QUERY = ("MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) "
+         "WHERE a.name = 'Alice' RETURN count(*) AS c")
+
+
+def run_query(graph):
+    return graph.cypher(QUERY).records.to_maps()[0]["c"]
+
+
+def time_queries(graph, iters: int):
+    run_query(graph)  # warm the compile caches
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_query(graph)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def edges_joined(src, dst, names) -> int:
+    """Edges processed by the two expand joins: each hop probes the full
+    relationship table (TEPS-style traversed-edges metric), plus the rows
+    the joins emit."""
+    import numpy as np
+    n_edges = len(src)
+    is_seed = np.array([names[s] == "Alice" for s in src])
+    hop1_out = int(is_seed.sum())
+    cnt1 = np.bincount(dst[is_seed], minlength=len(names))
+    hop2_out = int(cnt1[src].sum())
+    return 2 * n_edges + hop1_out + hop2_out
+
+
+def main():
+    import numpy as np
+    on_tpu = _probe_device()
+    if not on_tpu:
+        print("bench: axon TPU tunnel unreachable; running on CPU",
+              file=sys.stderr)
+        _force_cpu()
+
+    from caps_tpu.backends.local.session import LocalCypherSession
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+
+    rng = np.random.RandomState(42)
+    n_people, n_edges, n_seeds = 100_000, 500_000, 100
+
+    tpu_session = TPUCypherSession()
+    graph, src, dst, names = build_graph(tpu_session, n_people, n_edges,
+                                         n_seeds, rng)
+    expected = run_query(graph)
+    med = time_queries(graph, iters=10)
+    work = edges_joined(src, dst, names)
+    value = work / med
+    fallbacks = tpu_session.fallback_count
+
+    # Oracle baseline on a subsample, scaled per-edge.
+    rng2 = np.random.RandomState(42)
+    local_session = LocalCypherSession()
+    b_people, b_edges, b_seeds = 5_000, 25_000, 5
+    lgraph, lsrc, ldst, lnames = build_graph(local_session, b_people,
+                                             b_edges, b_seeds, rng2)
+    run_query(lgraph)
+    t0 = time.perf_counter()
+    run_query(lgraph)
+    local_t = time.perf_counter() - t0
+    local_rate = edges_joined(lsrc, ldst, lnames) / local_t
+    vs_baseline = value / local_rate if local_rate else 0.0
+
+    result = {
+        "metric": "edges-joined/sec, 2-hop foaf MATCH "
+                  f"({n_people} nodes, {n_edges} edges, "
+                  f"{'tpu' if on_tpu else 'cpu-fallback'}, "
+                  f"paths={expected}, device_fallbacks={fallbacks})",
+        "value": round(value, 1),
+        "unit": "edges/s",
+        "vs_baseline": round(vs_baseline, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

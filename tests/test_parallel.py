@@ -1,0 +1,63 @@
+"""Sharded execution on the 8-virtual-device CPU mesh (SURVEY.md §4:
+mesh size is config; same program runs 1-chip or v5e-8)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from caps_tpu.parallel.mesh import make_mesh
+from caps_tpu.parallel.query_step import (
+    make_collectives_smoke, make_sharded_two_hop, two_hop_count_kernel,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def _graph(n_nodes, n_edges, seed=7):
+    rng = np.random.RandomState(seed)
+    names = jnp.asarray(rng.randint(0, 5, n_nodes, dtype=np.int32))
+    src = jnp.asarray(rng.randint(0, n_nodes, n_edges, dtype=np.int32))
+    dst = jnp.asarray(rng.randint(0, n_nodes, n_edges, dtype=np.int32))
+    ok = jnp.ones(n_edges, bool)
+    return names, src, dst, ok
+
+
+def _expected_paths(names, src, dst, seed_code):
+    names, src, dst = map(np.asarray, (names, src, dst))
+    cnt1 = np.bincount(dst[names[src] == seed_code], minlength=len(names))
+    return int(cnt1[src].sum())
+
+
+def test_sharded_two_hop_matches_reference(mesh):
+    names, src, dst, ok = _graph(64, 8 * 32)
+    step = make_sharded_two_hop(mesh, 64)
+    total, cnt2 = step(names, src, dst, ok, jnp.int32(3))
+    assert int(total) == _expected_paths(names, src, dst, 3)
+    assert int(cnt2.sum()) == int(total)
+
+
+def test_mesh_size_is_config(mesh):
+    """The same kernel runs on a 1-device mesh and the 8-device mesh."""
+    names, src, dst, ok = _graph(32, 8 * 8, seed=9)
+    expected = _expected_paths(names, src, dst, 2)
+    for n in (1, 2, 8):
+        sub = make_mesh(n)
+        step = make_sharded_two_hop(sub, 32)
+        assert int(step(names, src, dst, ok, jnp.int32(2))[0]) == expected
+
+
+def test_collectives_smoke(mesh):
+    smoke = make_collectives_smoke(mesh)
+    out = smoke(jnp.arange(8 * 8, dtype=jnp.int32))
+    assert np.isfinite(int(out))
+
+
+def test_graft_entry_points():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    total, cnt2 = jax.jit(fn)(*args)
+    assert int(total) >= 0
+    g.dryrun_multichip(8)
