@@ -1,0 +1,208 @@
+"""Filesystem property-graph data source (Parquet / CSV).
+
+Mirrors the reference's ``FSGraphSource``/``GraphDirectoryStructure``/
+``CsvGraphLoader`` (ref: spark-cypher/.../api/io/fs/ — reconstructed,
+mount empty; SURVEY.md §2, §3.3): a graph is a directory
+
+    <root>/<graph-name>/
+        schema.json
+        nodes/<Label1_Label2>/part.parquet     (_id + property columns)
+        relationships/<TYPE>/part.parquet      (_id, _src, _tgt + properties)
+
+Arrow is the host-side format (SURVEY.md §7: strings/ids dictionary-encode
+at ingest; the device never sees a string).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as pq
+
+from caps_tpu.okapi.graph import GraphName, PropertyGraph
+from caps_tpu.okapi.io import PropertyGraphDataSource
+from caps_tpu.okapi.schema import Schema
+from caps_tpu.okapi.types import (
+    CTBoolean, CTFloat, CTInteger, CTString, CypherType, parse_type,
+)
+from caps_tpu.relational.entity_tables import (
+    NodeMapping, NodeTable, RelationshipMapping, RelationshipTable,
+)
+from caps_tpu.relational.graphs import RelationalCypherGraph, ScanGraph
+
+
+def _combo_dirname(labels) -> str:
+    return "_".join(sorted(labels)) if labels else "__no_label__"
+
+
+def _dirname_combo(name: str) -> Tuple[str, ...]:
+    return () if name == "__no_label__" else tuple(name.split("_"))
+
+
+class FSGraphSource(PropertyGraphDataSource):
+    def __init__(self, session, path: str, fmt: str = "parquet"):
+        if fmt not in ("parquet", "csv"):
+            raise ValueError(f"unsupported format {fmt!r}")
+        self.session = session
+        self.path = path
+        self.fmt = fmt
+        os.makedirs(path, exist_ok=True)
+
+    # -- paths ----------------------------------------------------------
+
+    def _graph_dir(self, name: GraphName) -> str:
+        return os.path.join(self.path, name.value)
+
+    def graph_names(self) -> Tuple[GraphName, ...]:
+        out = []
+        for entry in sorted(os.listdir(self.path)):
+            if os.path.isfile(os.path.join(self.path, entry, "schema.json")):
+                out.append(GraphName(entry))
+        return tuple(out)
+
+    def has_graph(self, name: GraphName) -> bool:
+        return os.path.isfile(os.path.join(self._graph_dir(name), "schema.json"))
+
+    def delete(self, name: GraphName) -> None:
+        shutil.rmtree(self._graph_dir(name), ignore_errors=True)
+
+    # -- io helpers ------------------------------------------------------
+
+    def _write_table(self, directory: str, data: Dict[str, List[Any]]) -> None:
+        os.makedirs(directory, exist_ok=True)
+        table = pa.table({k: pa.array(v) for k, v in data.items()})
+        if self.fmt == "parquet":
+            pq.write_table(table, os.path.join(directory, "part.parquet"))
+        else:
+            pacsv.write_csv(table, os.path.join(directory, "part.csv"))
+
+    def _read_table(self, directory: str) -> Dict[str, List[Any]]:
+        if self.fmt == "parquet":
+            table = pq.read_table(os.path.join(directory, "part.parquet"))
+        else:
+            table = pacsv.read_csv(os.path.join(directory, "part.csv"))
+        return {name: table.column(name).to_pylist()
+                for name in table.column_names}
+
+    # -- store -----------------------------------------------------------
+
+    def store(self, name: GraphName, graph: PropertyGraph) -> None:
+        if not isinstance(graph, RelationalCypherGraph):
+            raise TypeError("fs source can only store relational graphs")
+        gdir = self._graph_dir(name)
+        shutil.rmtree(gdir, ignore_errors=True)
+        os.makedirs(gdir, exist_ok=True)
+        schema = graph.schema
+        with open(os.path.join(gdir, "schema.json"), "w") as f:
+            json.dump(schema.to_json_dict(), f, indent=2)
+
+        for combo in schema.label_combinations:
+            data = self._node_scan_data(graph, combo)
+            self._write_table(
+                os.path.join(gdir, "nodes", _combo_dirname(combo)), data)
+        for rel_type in sorted(schema.relationship_types):
+            data = self._rel_scan_data(graph, rel_type)
+            self._write_table(
+                os.path.join(gdir, "relationships", rel_type), data)
+
+    def _node_scan_data(self, graph, combo) -> Dict[str, List[Any]]:
+        """Materialize one label combination's nodes via the scan path,
+        keeping only rows whose labels are exactly the combo."""
+        from caps_tpu.ir import exprs as E
+        header, table = graph.scan_node("n", combo)
+        ids = table.column_values(header.column(E.Var("n")))
+        label_cols = {e.label: table.column_values(header.column(e))
+                      for e in header.exprs if isinstance(e, E.HasLabel)}
+        keys = sorted(graph.schema.property_keys_for_combo(combo))
+        prop_cols = {}
+        for e in header.exprs:
+            if isinstance(e, E.Property) and e.key in keys:
+                prop_cols[e.key] = table.column_values(header.column(e))
+        rows = [i for i in range(len(ids))
+                if {l for l, col in label_cols.items() if col[i] is True}
+                == set(combo)]
+        data: Dict[str, List[Any]] = {"_id": [ids[i] for i in rows]}
+        for k in keys:
+            col = prop_cols.get(k, [None] * len(ids))
+            data[k] = [col[i] for i in rows]
+        return data
+
+    def _rel_scan_data(self, graph, rel_type: str) -> Dict[str, List[Any]]:
+        from caps_tpu.ir import exprs as E
+        header, table = graph.scan_rel("r", (rel_type,))
+        v = E.Var("r")
+        data: Dict[str, List[Any]] = {
+            "_id": table.column_values(header.column(v)),
+            "_src": table.column_values(header.column(E.StartNode(v))),
+            "_tgt": table.column_values(header.column(E.EndNode(v))),
+        }
+        keys = sorted(graph.schema.relationship_property_keys((rel_type,)))
+        for e in header.exprs:
+            if isinstance(e, E.Property) and e.key in keys:
+                data[e.key] = table.column_values(header.column(e))
+        return data
+
+    # -- schema / load ---------------------------------------------------
+
+    def schema(self, name: GraphName) -> Optional[Schema]:
+        path = os.path.join(self._graph_dir(name), "schema.json")
+        if not os.path.isfile(path):
+            return None
+        with open(path) as f:
+            doc = json.load(f)
+        schema = Schema.empty()
+        for node in doc.get("nodes", []):
+            keys = {k: parse_type(t) for k, t in node["properties"].items()}
+            schema = schema.with_node_property_keys(node["labels"], keys)
+        for rel in doc.get("relationships", []):
+            keys = {k: parse_type(t) for k, t in rel["properties"].items()}
+            schema = schema.with_relationship_property_keys(rel["type"], keys)
+        return schema
+
+    def graph(self, name: GraphName) -> ScanGraph:
+        if not self.has_graph(name):
+            raise KeyError(f"graph {name!r} not found under {self.path}")
+        schema = self.schema(name)
+        gdir = self._graph_dir(name)
+        factory = self.session.table_factory
+
+        node_tables = []
+        nodes_dir = os.path.join(gdir, "nodes")
+        if os.path.isdir(nodes_dir):
+            for entry in sorted(os.listdir(nodes_dir)):
+                combo = _dirname_combo(entry)
+                data = self._read_table(os.path.join(nodes_dir, entry))
+                keys = schema.property_keys_for_combo(combo)
+                types: Dict[str, CypherType] = {"_id": CTInteger}
+                for k in data:
+                    if k != "_id":
+                        types[k] = keys.get(k, CTString.nullable)
+                mapping = NodeMapping.on("_id").with_implied_labels(*combo)
+                for k in data:
+                    if k != "_id":
+                        mapping = mapping.with_property(k)
+                node_tables.append(
+                    NodeTable(mapping, factory.from_columns(data, types)))
+
+        rel_tables = []
+        rels_dir = os.path.join(gdir, "relationships")
+        if os.path.isdir(rels_dir):
+            for entry in sorted(os.listdir(rels_dir)):
+                data = self._read_table(os.path.join(rels_dir, entry))
+                keys = schema.relationship_property_keys((entry,))
+                types = {"_id": CTInteger, "_src": CTInteger,
+                         "_tgt": CTInteger}
+                for k in data:
+                    if k not in types:
+                        types[k] = keys.get(k, CTString.nullable)
+                mapping = RelationshipMapping.on(entry)
+                for k in data:
+                    if k not in ("_id", "_src", "_tgt"):
+                        mapping = mapping.with_property(k)
+                rel_tables.append(
+                    RelationshipTable(mapping, factory.from_columns(data, types)))
+        return ScanGraph(self.session, node_tables, rel_tables)

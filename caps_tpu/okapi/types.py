@@ -257,6 +257,32 @@ def join_all(types: Iterable[CypherType]) -> CypherType:
     return out
 
 
+def parse_type(s: str) -> CypherType:
+    """Inverse of ``repr``: parse "CTInteger?", "CTNode(A:B)",
+    "CTList(CTString)" etc. (used by the fs data source's schema.json)."""
+    s = s.strip()
+    nullable = s.endswith("?")
+    if nullable:
+        s = s[:-1]
+    simple = {
+        "CTVoid": CTVoid, "CTNull": CTNull, "CTAny": CTAny,
+        "CTBoolean": CTBoolean, "CTInteger": CTInteger, "CTFloat": CTFloat,
+        "CTNumber": CTNumber, "CTString": CTString, "CTMap": CTMap,
+        "CTPath": CTPath, "CTNode": _CTNode(), "CTRelationship": _CTRelationship(),
+    }
+    if s in simple:
+        t = simple[s]
+    elif s.startswith("CTNode(") and s.endswith(")"):
+        t = CTNode(s[len("CTNode("):-1].split(":"))
+    elif s.startswith("CTRelationship(") and s.endswith(")"):
+        t = CTRelationship(s[len("CTRelationship("):-1].split("|"))
+    elif s.startswith("CTList(") and s.endswith(")"):
+        t = CTList(parse_type(s[len("CTList("):-1]))
+    else:
+        raise ValueError(f"cannot parse CypherType {s!r}")
+    return t.nullable if nullable else t
+
+
 def from_python(value) -> CypherType:
     """Infer the CypherType of a plain Python value (literals, parameters)."""
     from caps_tpu.okapi import values as v

@@ -201,15 +201,8 @@ class RelationalCypherSession(CypherSession):
         params = dict(parameters or {})
         stmt = parse_query(query)
 
-        def schema_resolver(qgn: QualifiedGraphName) -> Schema:
-            src = self._catalog.source(qgn.namespace)
-            s = src.schema(qgn.graph_name)
-            if s is None:
-                raise KeyError(f"graph {qgn!r} not found")
-            return s
-
         t1 = time.perf_counter()
-        ir = IRBuilder(graph.schema, schema_resolver, params).process(stmt)
+        ir = IRBuilder(graph.schema, self._schema_resolver, params).process(stmt)
         t2 = time.perf_counter()
 
         if isinstance(ir, B.CreateGraphStatement):
@@ -218,19 +211,13 @@ class RelationalCypherSession(CypherSession):
             self._catalog.delete(ir.qgn)
             return RelationalCypherResult()
 
-        logical = LogicalPlanner(graph.schema, schema_resolver, params).process(ir)
+        logical = LogicalPlanner(graph.schema, self._schema_resolver,
+                                 params).process(ir)
         logical = LogicalOptimizer().process(logical)
         t3 = time.perf_counter()
 
         context = R.RelationalRuntimeContext(self, params)
-
-        def graph_resolver(qgn: QualifiedGraphName) -> RelationalCypherGraph:
-            g = self._catalog.graph(qgn)
-            if not isinstance(g, RelationalCypherGraph):
-                raise TypeError(f"graph {qgn!r} is not a relational graph")
-            return g
-
-        rel_planner = RelationalPlanner(context, graph, graph_resolver)
+        rel_planner = RelationalPlanner(context, graph, self._graph_resolver)
         root = rel_planner.process(logical)
         t4 = time.perf_counter()
 
@@ -263,13 +250,44 @@ class RelationalCypherSession(CypherSession):
             print(f"[caps-tpu] timings: {metrics}")
         return RelationalCypherResult(records, result_graph, plans, metrics)
 
-    # -- hooks for subclasses / later milestones ----------------------------
+    # -- graph-returning statements -----------------------------------------
 
     def _run_create_graph(self, graph, ir: B.CreateGraphStatement, params):
-        raise NotImplementedError("CATALOG CREATE GRAPH not implemented yet")
+        """CATALOG CREATE GRAPH qgn { inner }: evaluate the inner query's
+        graph and store it under the qualified name."""
+        inner = ir.inner
+        logical = LogicalPlanner(graph.schema, self._schema_resolver,
+                                 params).process(inner)
+        logical = LogicalOptimizer().process(logical)
+        context = R.RelationalRuntimeContext(self, params)
+        planner = RelationalPlanner(context, graph, self._graph_resolver)
+        root = planner.process(logical)
+        if not logical.returns_graph:
+            raise ValueError(
+                "CATALOG CREATE GRAPH requires the inner query to end with "
+                "RETURN GRAPH")
+        result_graph = self._evaluate_graph(root)
+        self._catalog.store(ir.qgn, result_graph)
+        return RelationalCypherResult(graph=result_graph)
 
     def _evaluate_graph(self, root: R.RelationalOperator):
-        raise NotImplementedError("RETURN GRAPH not implemented yet")
+        result_graph = getattr(root, "result_graph", None)
+        if result_graph is None:
+            raise ValueError("query does not produce a graph")
+        return result_graph
+
+    def _schema_resolver(self, qgn: QualifiedGraphName) -> Schema:
+        src = self._catalog.source(qgn.namespace)
+        s = src.schema(qgn.graph_name)
+        if s is None:
+            raise KeyError(f"graph {qgn!r} not found")
+        return s
+
+    def _graph_resolver(self, qgn: QualifiedGraphName) -> RelationalCypherGraph:
+        g = self._catalog.graph(qgn)
+        if not isinstance(g, RelationalCypherGraph):
+            raise TypeError(f"graph {qgn!r} is not a relational graph")
+        return g
 
     # -- helpers used by graphs ---------------------------------------------
 

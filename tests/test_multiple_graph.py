@@ -1,0 +1,147 @@
+"""Multiple-graph queries: FROM GRAPH, CONSTRUCT, RETURN GRAPH,
+CATALOG CREATE GRAPH, fs data source round-trip, federated MATCH
+(benchmark config 5)."""
+import pytest
+
+from caps_tpu.backends.local.session import LocalCypherSession
+from caps_tpu.backends.tpu.session import TPUCypherSession
+from caps_tpu.io.fs import FSGraphSource
+from caps_tpu.okapi.graph import Namespace
+from caps_tpu.testing.bag import Bag
+from caps_tpu.testing.factory import create_graph
+
+
+@pytest.fixture(params=["local", "tpu"])
+def session(request):
+    return (LocalCypherSession() if request.param == "local"
+            else TPUCypherSession())
+
+
+def test_from_graph_switches_graph(session):
+    g1 = create_graph(session, "CREATE (:A {v: 1})")
+    g2 = create_graph(session, "CREATE (:A {v: 2})")
+    session.catalog.store("g1", g1)
+    session.catalog.store("g2", g2)
+    rows = session.cypher(
+        "FROM GRAPH session.g1 MATCH (n:A) RETURN n.v AS v").records.to_maps()
+    assert rows == [{"v": 1}]
+    rows = session.cypher(
+        "FROM GRAPH session.g2 MATCH (n:A) RETURN n.v AS v").records.to_maps()
+    assert rows == [{"v": 2}]
+
+
+def test_union_branches_use_own_graphs(session):
+    g1 = create_graph(session, "CREATE (:A {v: 'g1'})")
+    g2 = create_graph(session, "CREATE (:A {v: 'g2'})")
+    session.catalog.store("g1", g1)
+    session.catalog.store("g2", g2)
+    rows = session.cypher(
+        "FROM GRAPH session.g1 MATCH (n:A) RETURN n.v AS v "
+        "UNION ALL FROM GRAPH session.g2 MATCH (m:A) RETURN m.v AS v"
+    ).records.to_maps()
+    assert Bag(rows) == [{"v": "g1"}, {"v": "g2"}]
+
+
+def test_construct_new_graph(session):
+    g = create_graph(session, "CREATE (:Person {name: 'Alice'}), "
+                              "(:Person {name: 'Bob'})")
+    result = g.cypher(
+        "MATCH (p:Person) CONSTRUCT NEW (:Copy {name: p.name}) RETURN GRAPH")
+    out = result.graph
+    assert out is not None
+    rows = out.cypher("MATCH (c:Copy) RETURN c.name AS n").records.to_maps()
+    assert Bag(rows) == [{"n": "Alice"}, {"n": "Bob"}]
+
+
+def test_construct_clone_and_new_edge(session):
+    g = create_graph(session, "CREATE (:P {name: 'a'}), (:P {name: 'b'})")
+    result = g.cypher(
+        "MATCH (p:P) CONSTRUCT CLONE p NEW (p)-[:TAGGED]->(:Tag {of: p.name}) "
+        "RETURN GRAPH")
+    out = result.graph
+    rows = out.cypher("MATCH (p:P)-[:TAGGED]->(t:Tag) "
+                      "RETURN p.name AS p, t.of AS t").records.to_maps()
+    assert Bag(rows) == [{"p": "a", "t": "a"}, {"p": "b", "t": "b"}]
+
+
+def test_construct_on_unions_with_base_graph(session):
+    base = create_graph(session, "CREATE (:X {v: 1})")
+    session.catalog.store("base", base)
+    g = create_graph(session, "CREATE (:Y {v: 2})")
+    result = g.cypher(
+        "MATCH (y:Y) CONSTRUCT ON session.base NEW (:Z {v: y.v}) RETURN GRAPH")
+    out = result.graph
+    rows = out.cypher("MATCH (n) RETURN labels(n) AS l, n.v AS v").records.to_maps()
+    assert Bag(rows) == [{"l": ["X"], "v": 1}, {"l": ["Z"], "v": 2}]
+
+
+def test_construct_set(session):
+    g = create_graph(session, "CREATE (:P {name: 'a'})")
+    out = g.cypher("MATCH (p:P) CONSTRUCT CLONE p SET p.seen = true "
+                   "SET p:Checked RETURN GRAPH").graph
+    rows = out.cypher("MATCH (p:Checked) RETURN p.name AS n, p.seen AS s"
+                      ).records.to_maps()
+    assert rows == [{"n": "a", "s": True}]
+
+
+def test_catalog_create_graph(session):
+    g = create_graph(session, "CREATE (:A {v: 1})-[:R]->(:B {v: 2})")
+    session.catalog.store("src", g)
+    session.cypher(
+        "CATALOG CREATE GRAPH session.snapshot { FROM GRAPH session.src "
+        "MATCH (a:A)-[r:R]->(b:B) CONSTRUCT CLONE a, b NEW (a)-[:R2]->(b) "
+        "RETURN GRAPH }")
+    snap = session.catalog.graph("session.snapshot")
+    rows = snap.cypher("MATCH (a)-[:R2]->(b) RETURN a.v AS a, b.v AS b"
+                       ).records.to_maps()
+    assert rows == [{"a": 1, "b": 2}]
+
+
+def test_return_graph_of_from_graph(session):
+    g = create_graph(session, "CREATE (:A {v: 7})")
+    session.catalog.store("g", g)
+    out = session.cypher("FROM GRAPH session.g RETURN GRAPH").graph
+    rows = out.cypher("MATCH (n:A) RETURN n.v AS v").records.to_maps()
+    assert rows == [{"v": 7}]
+
+
+@pytest.mark.parametrize("fmt", ["parquet", "csv"])
+def test_fs_roundtrip(session, tmp_path, fmt):
+    src = FSGraphSource(session, str(tmp_path), fmt=fmt)
+    session.catalog.register_source(Namespace("fs"), src)
+    g = create_graph(session,
+                     "CREATE (a:Person {name: 'Alice', age: 23})"
+                     "-[:KNOWS {since: 2020}]->(b:Person:Admin {name: 'Bob'})")
+    session.catalog.store("fs.people", g)
+    # read back through the catalog
+    g2 = session.catalog.graph("fs.people")
+    assert g2.schema == g.schema
+    rows = g2.cypher("MATCH (a:Person)-[k:KNOWS]->(b:Admin) "
+                     "RETURN a.name AS a, k.since AS s, b.name AS b"
+                     ).records.to_maps()
+    assert rows == [{"a": "Alice", "s": 2020, "b": "Bob"}]
+
+
+def test_federated_match_across_sources(session, tmp_path):
+    """Config 5: a query touching graphs from two data sources."""
+    src = FSGraphSource(session, str(tmp_path))
+    session.catalog.register_source(Namespace("fs"), src)
+    products = create_graph(session, "CREATE (:Product {sku: 1, name: 'x'})")
+    session.catalog.store("fs.products", products)
+    customers = create_graph(session, "CREATE (:Customer {name: 'c', wants: 1})")
+    session.catalog.store("customers", customers)
+
+    rows = session.cypher(
+        "FROM GRAPH session.customers MATCH (c:Customer) "
+        "WITH c.name AS cname, c.wants AS sku "
+        "FROM GRAPH fs.products MATCH (p:Product) WHERE p.sku = sku "
+        "RETURN cname, p.name AS product").records.to_maps()
+    assert rows == [{"cname": "c", "product": "x"}]
+
+
+def test_graph_union_all(session):
+    g1 = create_graph(session, "CREATE (:A {v: 1})")
+    g2 = create_graph(session, "CREATE (:B {v: 2})")
+    u = g1.union_all(g2)
+    rows = u.cypher("MATCH (n) RETURN n.v AS v").records.to_maps()
+    assert Bag(rows) == [{"v": 1}, {"v": 2}]
