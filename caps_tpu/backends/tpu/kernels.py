@@ -50,19 +50,31 @@ def mask_count(mask: jnp.ndarray) -> jnp.ndarray:
 # -- sort-merge join --------------------------------------------------------
 
 @jax.jit
-def join_count(l_key, l_ok, r_key, r_ok):
-    """Phase 1: per-left-row match counts against the right side.
-
-    Returns (counts, lo, perm) where perm sorts the right side and lo is
-    each left row's first match position in the sorted right keys.
-    """
+def sort_right(r_key, r_ok):
+    """Sort the join build side once; cacheable per (column, live-count)
+    so repeated probes of a static scan table (every Expand hop joins the
+    same relationship table) skip the O(n log²n) re-sort."""
     cap_r = r_key.shape[0]
-    lk = jnp.where(l_ok, l_key.astype(jnp.int64), _L_NULL)
     rk = jnp.where(r_ok, r_key.astype(jnp.int64), _R_NULL)
     rk_sorted, perm = jax.lax.sort((rk, jnp.arange(cap_r)), num_keys=1)
+    return rk_sorted, perm
+
+
+@jax.jit
+def probe_count(l_key, l_ok, rk_sorted):
+    """Phase 1: per-left-row match counts against the sorted right keys."""
+    lk = jnp.where(l_ok, l_key.astype(jnp.int64), _L_NULL)
     lo = jnp.searchsorted(rk_sorted, lk, side="left")
     hi = jnp.searchsorted(rk_sorted, lk, side="right")
     counts = jnp.where(l_ok, hi - lo, 0)
+    return counts, lo
+
+
+@jax.jit
+def join_count(l_key, l_ok, r_key, r_ok):
+    """Phase 1 without caching: sort the right side, then probe."""
+    rk_sorted, perm = sort_right(r_key, r_ok)
+    counts, lo = probe_count(l_key, l_ok, rk_sorted)
     return counts, lo, perm
 
 
@@ -116,6 +128,25 @@ def neighbor_change(sorted_keys_stacked: jnp.ndarray) -> jnp.ndarray:
 
 
 # -- segmented aggregation --------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "kind"))
+def sorted_segment_agg(values, ok, seg_id, num_segments: int, kind: str):
+    """Sum/count over *non-decreasing* ``seg_id`` via cumulative sum +
+    boundary gather — a scan and two gathers instead of XLA scatter-add,
+    which serializes on TPU.  Exact for integers (int64 cumsum); the
+    group-by path sorts rows first, so its seg_ids always qualify."""
+    if kind == "count":
+        v = ok.astype(jnp.int64)
+    elif kind == "sum":
+        v = jnp.where(ok, values, 0)
+    else:
+        raise ValueError(f"sorted_segment_agg supports count/sum, not {kind}")
+    c = jnp.cumsum(v)
+    ends = jnp.searchsorted(seg_id, jnp.arange(num_segments),
+                            side="right") - 1
+    cum = jnp.where(ends >= 0, c[jnp.clip(ends, 0, None)], 0)
+    prev = jnp.concatenate([jnp.zeros(1, cum.dtype), cum[:-1]])
+    return cum - prev
 
 @functools.partial(jax.jit, static_argnames=("num_segments", "kind"))
 def segment_agg(values, ok, seg_id, num_segments: int, kind: str):

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from caps_tpu import ops as OPS
 from caps_tpu.backends.local.table import LocalTable, LocalTableFactory
 from caps_tpu.backends.tpu import kernels as K
 from caps_tpu.backends.tpu.column import (
@@ -225,14 +226,26 @@ class DeviceTable(Table):
             return col.data.astype(jnp.int64)
         raise UnsupportedOnDevice(f"join key of kind {col.kind}")
 
+    def _cached_right_sort(self, other: "DeviceTable", rcol: Column):
+        """Sort of the build side, memoized on the column object: static
+        scan tables (the relationship table every Expand hop probes) are
+        sorted once per graph, not once per hop."""
+        key = (other._n,)
+        cached = getattr(rcol, "_join_sort", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        r_ok = rcol.valid & other.row_ok
+        res = K.sort_right(self._join_key(rcol), r_ok)
+        rcol._join_sort = (key, res)
+        return res
+
     def _sort_merge_join(self, other: "DeviceTable", how: str,
                          pairs: Sequence[Tuple[str, str]]) -> "DeviceTable":
         lc, rc = pairs[0]
         lcol, rcol = self._cols[lc], other._cols[rc]
         l_ok = lcol.valid & self.row_ok
-        r_ok = rcol.valid & other.row_ok
-        counts, lo, perm = K.join_count(self._join_key(lcol), l_ok,
-                                        self._join_key(rcol), r_ok)
+        rk_sorted, perm = self._cached_right_sort(other, rcol)
+        counts, lo = K.probe_count(self._join_key(lcol), l_ok, rk_sorted)
         left_join = how == "left"
         total = int(K.join_total(counts, l_ok, left_join))
         out_cap = self.backend.bucket(total)
@@ -367,6 +380,9 @@ class DeviceTable(Table):
                 raise UnsupportedOnDevice(f"{a.kind} aggregation")
             if a.distinct:
                 raise UnsupportedOnDevice("DISTINCT aggregation")
+        fast = self._group_dense_pallas(by, aggs)
+        if fast is not None:
+            return fast
         cap = self.capacity
         pool = self.backend.pool
         if by:
@@ -405,18 +421,98 @@ class DeviceTable(Table):
                                         row_ok_sorted, n_groups)
         return DeviceTable(self.backend, out, n_groups)
 
+    def _group_dense_pallas(self, by: Sequence[str],
+                            aggs: Sequence[AggSpec]
+                            ) -> Optional["DeviceTable"]:
+        """Sort-free group-by over a dictionary-coded key: the string pool
+        makes group keys a *dense* int domain, so grouping is a Pallas
+        histogram (caps_tpu/ops/segment.py) — no lax.sort, no scatter.
+        Returns None when the shape doesn't fit (engine falls back to the
+        sorted path)."""
+        cfg = self.backend.config
+        if not cfg.use_pallas or len(by) != 1:
+            return None
+        key_col = self._cols.get(by[0])
+        if key_col is None or key_col.kind not in ("str", "bool"):
+            return None
+        domain = len(self.backend.pool) if key_col.kind == "str" else 2
+        S = domain + 1  # one slot for the null-key group
+        if S > 4096 or S > self.capacity * 64:
+            return None
+        for a in aggs:
+            if a.kind not in ("count_star", "count", "min", "max"):
+                return None
+            if a.kind in ("min", "max"):
+                c = self._cols.get(a.col)
+                if c is None or c.kind not in ("int", "id"):
+                    return None
+        row_ok = self.row_ok
+        # int64 min/max ride the i32 kernel only when the values fit
+        for c in {a.col for a in aggs if a.kind in ("min", "max")}:
+            col = self._cols[c]
+            if col.kind == "int":
+                ok = col.valid & row_ok
+                lo = int(jnp.min(jnp.where(ok, col.data, 0)))
+                hi = int(jnp.max(jnp.where(ok, col.data, 0)))
+                if not (-2**31 < lo and hi < 2**31):
+                    return None
+
+        interp = OPS.default_interpret()
+        codes = jnp.where(key_col.valid & row_ok,
+                          key_col.data.astype(jnp.int32), domain)
+        counts_all = OPS.dense_segment_agg(codes, row_ok, codes, S, "count",
+                                           interpret=interp)
+        count_cache: Dict[str, jnp.ndarray] = {}
+
+        def count_of(col_name: str) -> jnp.ndarray:
+            if col_name not in count_cache:
+                col = self._cols[col_name]
+                count_cache[col_name] = OPS.dense_segment_agg(
+                    codes, col.valid & row_ok, codes, S, "count",
+                    interpret=interp)
+            return count_cache[col_name]
+
+        out: Dict[str, Column] = {}
+        live = jnp.ones(S, bool)
+        if key_col.kind == "str":
+            out[by[0]] = Column("str", jnp.arange(S, dtype=jnp.int32),
+                                jnp.arange(S) < domain, key_col.ctype)
+        else:
+            out[by[0]] = Column("bool", jnp.arange(S) == 1,
+                                jnp.arange(S) < domain, key_col.ctype)
+        for a in aggs:
+            if a.kind == "count_star":
+                out[a.name] = Column("int", counts_all.astype(jnp.int64),
+                                     live, CTInteger)
+            elif a.kind == "count":
+                out[a.name] = Column("int",
+                                     count_of(a.col).astype(jnp.int64),
+                                     live, CTInteger)
+            else:  # min / max over int/id
+                col = self._cols[a.col]
+                vals = col.data.astype(jnp.int32)
+                agg = OPS.dense_segment_agg(
+                    codes, col.valid & row_ok, vals, S,
+                    "min_i32" if a.kind == "min" else "max_i32",
+                    interpret=interp)
+                has = count_of(a.col) > 0
+                out[a.name] = Column(col.kind, agg.astype(
+                    jnp.int64 if col.kind == "int" else jnp.int32),
+                    has, col.ctype)
+        dense = DeviceTable(self.backend, out, S)
+        return dense._compact(counts_all > 0)
+
     def _one_agg(self, a: AggSpec, cols: Dict[str, Column], seg_id,
                  num_segments: int, row_ok, n_groups: int) -> Column:
         group_live = jnp.arange(num_segments) < n_groups
         if a.kind == "count_star":
-            data = K.segment_agg(row_ok.astype(jnp.int64), row_ok, seg_id,
-                                 num_segments, "count")
+            data = K.sorted_segment_agg(row_ok, row_ok, seg_id,
+                                        num_segments, "count")
             return Column("int", data, group_live, CTInteger)
         col = cols[a.col]
         ok = col.valid & row_ok
         if a.kind == "count":
-            data = K.segment_agg(col.data if col.kind != "list" else col.lens,
-                                 ok, seg_id, num_segments, "count")
+            data = K.sorted_segment_agg(ok, ok, seg_id, num_segments, "count")
             return Column("int", data, group_live, CTInteger)
         if col.kind == "list":
             raise UnsupportedOnDevice(f"{a.kind} over list column")
@@ -442,7 +538,11 @@ class DeviceTable(Table):
         values = col.data
         counts = K.segment_agg(values, ok, seg_id, num_segments, "count")
         if a.kind == "sum":
-            data = K.segment_agg(values, ok, seg_id, num_segments, "sum")
+            if col.kind in ("int", "bool"):
+                data = K.sorted_segment_agg(values.astype(jnp.int64), ok,
+                                            seg_id, num_segments, "sum")
+            else:
+                data = K.segment_agg(values, ok, seg_id, num_segments, "sum")
             return Column(col.kind if col.kind != "bool" else "int",
                           data, group_live,
                           a.result_type or col.ctype)

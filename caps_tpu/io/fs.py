@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import urllib.parse
 from typing import Any, Dict, List, Optional, Tuple
 
 import pyarrow as pa
@@ -35,12 +36,26 @@ from caps_tpu.relational.entity_tables import (
 from caps_tpu.relational.graphs import RelationalCypherGraph, ScanGraph
 
 
+def _encode_name(name: str) -> str:
+    # Percent-encode path-unsafe characters AND '_' (the combo separator),
+    # so labels containing '_' or '/' round-trip and distinct combos never
+    # collide on the joined dirname.  Decoding is a plain unquote.
+    return urllib.parse.quote(name, safe="").replace("_", "%5F")
+
+
+def _decode_name(name: str) -> str:
+    return urllib.parse.unquote(name)
+
+
 def _combo_dirname(labels) -> str:
-    return "_".join(sorted(labels)) if labels else "__no_label__"
+    return "_".join(_encode_name(l) for l in sorted(labels)) \
+        if labels else "__no_label__"
 
 
 def _dirname_combo(name: str) -> Tuple[str, ...]:
-    return () if name == "__no_label__" else tuple(name.split("_"))
+    if name == "__no_label__":
+        return ()
+    return tuple(_decode_name(part) for part in name.split("_"))
 
 
 class FSGraphSource(PropertyGraphDataSource):
@@ -107,7 +122,8 @@ class FSGraphSource(PropertyGraphDataSource):
         for rel_type in sorted(schema.relationship_types):
             data = self._rel_scan_data(graph, rel_type)
             self._write_table(
-                os.path.join(gdir, "relationships", rel_type), data)
+                os.path.join(gdir, "relationships", _encode_name(rel_type)),
+                data)
 
     def _node_scan_data(self, graph, combo) -> Dict[str, List[Any]]:
         """Materialize one label combination's nodes via the scan path,
@@ -192,14 +208,15 @@ class FSGraphSource(PropertyGraphDataSource):
         rels_dir = os.path.join(gdir, "relationships")
         if os.path.isdir(rels_dir):
             for entry in sorted(os.listdir(rels_dir)):
+                rel_type = _decode_name(entry)
                 data = self._read_table(os.path.join(rels_dir, entry))
-                keys = schema.relationship_property_keys((entry,))
+                keys = schema.relationship_property_keys((rel_type,))
                 types = {"_id": CTInteger, "_src": CTInteger,
                          "_tgt": CTInteger}
                 for k in data:
                     if k not in types:
                         types[k] = keys.get(k, CTString.nullable)
-                mapping = RelationshipMapping.on(entry)
+                mapping = RelationshipMapping.on(rel_type)
                 for k in data:
                     if k not in ("_id", "_src", "_tgt"):
                         mapping = mapping.with_property(k)

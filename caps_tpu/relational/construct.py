@@ -85,18 +85,19 @@ class ConstructOp(R.RelationalOperator):
 
         # Vars used as NEW endpoints that are bound in scope become
         # implicit clones.
-        new_vars: List[Tuple[str, ast.NodePattern]] = []
         bound = set(header.vars)
         for pat in self.news:
             for part in pat.parts:
                 for el in part.elements:
                     if isinstance(el, ast.NodePattern) and el.var \
-                            and el.var not in bound \
-                            and el.var not in clone_specs:
-                        pass  # fresh var: created below
-                    elif isinstance(el, ast.NodePattern) and el.var \
                             and el.var in bound and el.var not in clone_specs:
                         clone_specs[el.var] = E.Var(el.var)
+
+        # SET on a cloned ON-graph entity must *replace* the original, not
+        # add a modified twin beside it (UnionGraph ids are disjoint).  In
+        # that case the ON graphs are materialized into the build and the
+        # union is dropped — overlay semantics.
+        overlay = bool(self.on_graphs) and bool(set_vars & set(clone_specs))
 
         # Materialize what each bound entity var looks like per row.
         def entity_rows(var: str):
@@ -143,9 +144,14 @@ class ConstructOp(R.RelationalOperator):
 
         # nodes[id] = (set(labels), {key: value}); collected then grouped
         nodes: Dict[int, Tuple[set, Dict[str, Any]]] = {}
-        rels: List[Tuple[int, int, int, str, Dict[str, Any]]] = []
+        # rels[id] = [src, tgt, type, {key: value}]
+        rels: Dict[int, List[Any]] = {}
         # per-row id bindings for construct-scope vars
         row_ids: Dict[str, List[Optional[int]]] = {}
+
+        if overlay:
+            for g in self.on_graphs:
+                _materialize_graph_into(nodes, rels, g)
 
         from caps_tpu.okapi.types import _CTRelationship
         # 1. clones
@@ -156,20 +162,18 @@ class ConstructOp(R.RelationalOperator):
             if isinstance(src_t, _CTRelationship):
                 ids, srcs, tgts, typs, props = rel_rows(src.name)
                 row_ids[var] = ids
-                if self.on_graphs and var not in set_vars:
-                    continue
-                seen = set()
+                if self.on_graphs and not overlay and var not in set_vars:
+                    continue  # entity already present via the ON-union
                 for i, rid in enumerate(ids):
-                    if rid is None or rid in seen:
+                    if rid is None or rid in rels:
                         continue
-                    seen.add(rid)
                     p = {k: col[i] for k, col in props if col[i] is not None}
-                    rels.append((rid, srcs[i], tgts[i], typs[i] or "", p))
+                    rels[rid] = [srcs[i], tgts[i], typs[i] or "", p]
             else:
                 ids, labels, props = entity_rows(src.name)
                 row_ids[var] = ids
-                if self.on_graphs and var not in set_vars:
-                    continue
+                if self.on_graphs and not overlay and var not in set_vars:
+                    continue  # entity already present via the ON-union
                 for i, nid in enumerate(ids):
                     if nid is None or nid in nodes:
                         continue
@@ -232,8 +236,8 @@ class ConstructOp(R.RelationalOperator):
                                     continue
                                 if rel.direction == ast.Direction.INCOMING:
                                     a, b = b, a
-                                rels.append((rids[i], a, b,
-                                             rel.rel_types[0], rprops[i]))
+                                rels[rids[i]] = [a, b, rel.rel_types[0],
+                                                 rprops[i]]
                             pending_rel = None
                         prev_ids = ids
                     else:
@@ -256,22 +260,54 @@ class ConstructOp(R.RelationalOperator):
             resolved = R.resolve_expr(item.value, header)
             col = evaluate(resolved, n, lambda c: table.column_values(c),
                            header, params)
-            rel_index = {r[0]: idx for idx, r in enumerate(rels)}
             for i, eid in enumerate(ids):
                 if eid is None or col[i] is None:
                     continue
                 if eid in nodes:
                     nodes[eid][1][item.key] = col[i]
-                elif eid in rel_index:
-                    idx = rel_index[eid]
-                    rels[idx][4][item.key] = col[i]
+                elif eid in rels:
+                    rels[eid][3][item.key] = col[i]
 
         built = _tables_from_entities(self.session, nodes, rels)
-        graphs = tuple(self.on_graphs) + (built,)
+        graphs = ((tuple(self.on_graphs) if not overlay else ())
+                  + (built,))
         if len(graphs) == 1:
             return built
         from caps_tpu.relational.graphs import UnionGraph
         return UnionGraph(self.session, graphs)
+
+
+def _materialize_graph_into(nodes: Dict[int, Tuple[set, Dict[str, Any]]],
+                            rels: Dict[int, List[Any]], graph) -> None:
+    """Copy a graph's entities into the host-side build dicts (overlay
+    path: ON-graph entities get replaced by SET-modified clones in place).
+    First writer wins, matching the clone loops' dedup-by-id."""
+    for nt in getattr(graph, "node_tables", ()):
+        m = nt.mapping
+        ids = nt.table.column_values(m.id_col)
+        prop_cols = {k: nt.table.column_values(c)
+                     for k, c in m.property_cols.items()}
+        for i, nid in enumerate(ids):
+            if nid is None or nid in nodes:
+                continue
+            props = {k: col[i] for k, col in prop_cols.items()
+                     if col[i] is not None}
+            nodes[nid] = (set(m.labels), props)
+    for rt in getattr(graph, "rel_tables", ()):
+        m = rt.mapping
+        ids = rt.table.column_values(m.id_col)
+        srcs = rt.table.column_values(m.source_col)
+        tgts = rt.table.column_values(m.target_col)
+        prop_cols = {k: rt.table.column_values(c)
+                     for k, c in m.property_cols.items()}
+        for i, rid in enumerate(ids):
+            if rid is None or rid in rels:
+                continue
+            props = {k: col[i] for k, col in prop_cols.items()
+                     if col[i] is not None}
+            rels[rid] = [srcs[i], tgts[i], m.rel_type, props]
+    for sub in getattr(graph, "graphs", ()):
+        _materialize_graph_into(nodes, rels, sub)
 
 
 def _max_graph_id(graph) -> int:
@@ -321,7 +357,7 @@ def _tables_from_entities(session, nodes, rels):
         node_tables.append(NodeTable(mapping, factory.from_columns(data, types)))
 
     by_type: Dict[str, List[Tuple[int, int, int, Dict[str, Any]]]] = {}
-    for rid, src, tgt, rel_type, props in rels:
+    for rid, (src, tgt, rel_type, props) in rels.items():
         by_type.setdefault(rel_type, []).append((rid, src, tgt, props))
     rel_tables = []
     for rel_type, rows in sorted(by_type.items()):

@@ -145,3 +145,51 @@ def test_graph_union_all(session):
     u = g1.union_all(g2)
     rows = u.cypher("MATCH (n) RETURN n.v AS v").records.to_maps()
     assert Bag(rows) == [{"v": 1}, {"v": 2}]
+
+
+def test_construct_on_set_clone_replaces_original(session):
+    """SET on a clone of an ON-graph entity must not leave a duplicate id
+    in the union: the modified copy replaces the original (overlay)."""
+    base = create_graph(session, "CREATE (:A {v: 1})-[:R]->(:A {v: 2})")
+    session.catalog.store("base", base)
+    out = session.cypher(
+        "FROM GRAPH session.base MATCH (x:A) "
+        "CONSTRUCT ON session.base CLONE x SET x.flag = true "
+        "RETURN GRAPH").graph
+    rows = out.cypher("MATCH (n:A) RETURN n.v AS v, n.flag AS f"
+                      ).records.to_maps()
+    assert Bag(rows) == [{"v": 1, "f": True}, {"v": 2, "f": True}]
+    # relationships from the ON graph survive the overlay
+    rels = out.cypher("MATCH (:A)-[r:R]->(:A) RETURN count(*) AS c"
+                      ).records.to_maps()
+    assert rels == [{"c": 1}]
+
+
+@pytest.mark.parametrize("fmt", ["parquet", "csv"])
+def test_fs_roundtrip_label_with_underscore(session, tmp_path, fmt):
+    src = FSGraphSource(session, str(tmp_path), fmt=fmt)
+    session.catalog.register_source(Namespace("fsu"), src)
+    g = create_graph(session,
+                     "CREATE (:My_Label {v: 1})-[:HAS_PART]->(:Other {v: 2})")
+    session.catalog.store("fsu.g", g)
+    loaded = session.catalog.graph("fsu.g")
+    rows = loaded.cypher("MATCH (n:My_Label) RETURN n.v AS v"
+                         ).records.to_maps()
+    assert rows == [{"v": 1}]
+    rels = loaded.cypher(
+        "MATCH (:My_Label)-[r:HAS_PART]->(m) RETURN m.v AS v"
+        ).records.to_maps()
+    assert rels == [{"v": 2}]
+
+
+def test_fs_no_combo_collision(session, tmp_path):
+    """('A_B',) and ('A','B') must store to distinct directories."""
+    src = FSGraphSource(session, str(tmp_path), fmt="parquet")
+    session.catalog.register_source(Namespace("fsc"), src)
+    g = create_graph(session, "CREATE (:A_B {v: 1}), (n:A:B {v: 2})")
+    session.catalog.store("fsc.g", g)
+    loaded = session.catalog.graph("fsc.g")
+    assert loaded.cypher("MATCH (n:A_B) RETURN n.v AS v"
+                         ).records.to_maps() == [{"v": 1}]
+    assert loaded.cypher("MATCH (n:A:B) RETURN n.v AS v"
+                         ).records.to_maps() == [{"v": 2}]

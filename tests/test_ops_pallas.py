@@ -1,0 +1,61 @@
+"""Differential tests: Pallas kernels vs their jnp reference twins
+(SURVEY.md §7 step 6 — every kernel keeps a jnp twin for testing).
+
+Run in interpreter mode on CPU; the same kernel code compiles on TPU.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from caps_tpu.ops import dense_segment_agg, dense_segment_agg_ref
+
+KINDS = ["count", "sum_f32", "sum_i32", "min_i32", "max_i32",
+         "min_f32", "max_f32"]
+
+
+def _case(rng, n, s):
+    codes = rng.randint(0, s, n).astype(np.int32)
+    ok = rng.rand(n) < 0.8
+    return codes, ok
+
+
+@pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.parametrize("n,s", [(1000, 7), (513, 130), (4096, 1),
+                                 (100, 300), (1, 1)])
+def test_dense_segment_agg_matches_ref(kind, n, s):
+    # NB: deterministic seed — hash() is salted per process.
+    rng = np.random.RandomState((len(kind) * 1009 + n * 31 + s) % 2**31)
+    codes, ok = _case(rng, n, s)
+    if kind.endswith("f32"):
+        values = rng.randn(n).astype(np.float32)
+    else:
+        values = rng.randint(-1000, 1000, n).astype(np.int32)
+    got = dense_segment_agg(jnp.asarray(codes), jnp.asarray(ok),
+                            jnp.asarray(values), s, kind, interpret=True)
+    want = dense_segment_agg_ref(jnp.asarray(codes), jnp.asarray(ok),
+                                 jnp.asarray(values), s, kind)
+    assert got.shape == want.shape == (s,)
+    if kind.endswith("f32"):
+        # f32 sums differ by reduction order; absolute tolerance scales
+        # with segment population.
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-3 * np.sqrt(n))
+    else:
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_dense_segment_agg_empty_input():
+    got = dense_segment_agg(jnp.zeros(0, jnp.int32), jnp.zeros(0, bool),
+                            jnp.zeros(0, jnp.int32), 5, "count",
+                            interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.zeros(5))
+
+
+def test_dense_segment_agg_all_masked():
+    codes = jnp.asarray(np.array([0, 1, 2], np.int32))
+    ok = jnp.zeros(3, bool)
+    vals = jnp.asarray(np.array([5, 6, 7], np.int32))
+    got = dense_segment_agg(codes, ok, vals, 3, "count", interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.zeros(3))
+    got_min = dense_segment_agg(codes, ok, vals, 3, "min_i32", interpret=True)
+    assert np.all(np.asarray(got_min) == np.iinfo(np.int32).max)
