@@ -14,8 +14,10 @@ so benchmarks can assert the hot path stayed on-device.
 """
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,13 +37,50 @@ from caps_tpu.relational.table import AggSpec, Table, TableFactory
 
 
 class DeviceBackend:
-    """Shared per-session state: string pool, config, fallback counter."""
+    """Shared per-session state: string pool, config, mesh, fallback counter.
+
+    Distribution model (SURVEY.md §7 step 7): with a mesh configured,
+    columns are row-sharded over the mesh axis via ``NamedSharding`` and
+    every jitted operator runs SPMD — XLA's partitioner inserts the
+    collectives (all_gather for sort/probe, all_to_all for repartition),
+    the scaling-book recipe.  Hand-written shard_map paths (the pushdown
+    query step, the sharded Pallas aggregation) override it where we can
+    schedule ICI traffic better than the partitioner.
+    """
 
     def __init__(self, config: EngineConfig):
         self.pool = StringPool()
         self.config = config
         self.fallbacks = 0
         self.fallback_reasons: List[str] = []
+        self.mesh = None
+        self.axis = config.mesh_axis
+        if config.mesh_shape:
+            from caps_tpu.parallel.mesh import make_mesh
+            self.mesh = make_mesh(math.prod(config.mesh_shape),
+                                  axis=self.axis)
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.devices.size) if self.mesh is not None else 1
+
+    def place_rows(self, arr: jnp.ndarray) -> jnp.ndarray:
+        """Row-shard an array over the mesh (no-op single-chip or when the
+        row count doesn't divide)."""
+        if (self.mesh is None or arr.ndim == 0
+                or arr.shape[0] % self.n_shards):
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = (self.axis,) + (None,) * (arr.ndim - 1)
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+
+    def place_column(self, col: Column) -> Column:
+        if self.mesh is None:
+            return col
+        return Column(col.kind, self.place_rows(col.data),
+                      self.place_rows(col.valid), col.ctype,
+                      self.place_rows(col.lens) if col.lens is not None
+                      else None)
 
     def bucket(self, n: int) -> int:
         return max(1, self.config.bucket_for(n))
@@ -145,7 +184,9 @@ class DeviceTable(Table):
             return self._wrap_local(
                 self._local.with_literal_column(name, value, ctype))
         try:
-            col = literal_column(value, ctype, self.capacity, self.backend.pool)
+            col = self.backend.place_column(
+                literal_column(value, ctype, self.capacity,
+                               self.backend.pool))
         except ValueError as ex:
             return self._fallback(str(ex)).with_literal_column(
                 name, value, ctype)
@@ -156,8 +197,9 @@ class DeviceTable(Table):
     def with_row_index(self, name: str) -> "DeviceTable":
         if self._local is not None:
             return self._wrap_local(self._local.with_row_index(name))
-        col = Column("int", jnp.arange(self.capacity, dtype=jnp.int64),
-                     jnp.ones(self.capacity, bool), CTInteger)
+        col = self.backend.place_column(
+            Column("int", jnp.arange(self.capacity, dtype=jnp.int64),
+                   jnp.ones(self.capacity, bool), CTInteger))
         out = dict(self._cols)
         out[name] = col
         return DeviceTable(self.backend, out, self._n)
@@ -201,6 +243,7 @@ class DeviceTable(Table):
         new_n = int(K.mask_count(mask))
         out_cap = self.backend.bucket(new_n)
         idx, _ = K.compact_indices(mask, out_cap)
+        idx = self.backend.place_rows(idx)
         return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
 
     def join(self, other: Table, how: str,
@@ -251,6 +294,8 @@ class DeviceTable(Table):
         out_cap = self.backend.bucket(total)
         l_idx, r_idx, out_valid, r_matched, _ = K.join_expand(
             counts, lo, perm, l_ok, out_cap, left_join)
+        l_idx = self.backend.place_rows(l_idx)
+        r_idx = self.backend.place_rows(r_idx)
         out_cols = _gather_cols(self._cols, l_idx)
         right = _gather_cols(other._cols, r_idx)
         for c, col in right.items():
@@ -458,18 +503,28 @@ class DeviceTable(Table):
                     return None
 
         interp = OPS.default_interpret()
+        backend = self.backend
+        sharded = (backend.mesh is not None
+                   and self.capacity % backend.n_shards == 0)
+
+        def agg_kernel(codes_, ok_, vals_, kind_):
+            if sharded:
+                return OPS.dense_segment_agg_sharded(
+                    backend.mesh, backend.axis, codes_, ok_, vals_, S, kind_,
+                    interpret=interp)
+            return OPS.dense_segment_agg(codes_, ok_, vals_, S, kind_,
+                                         interpret=interp)
+
         codes = jnp.where(key_col.valid & row_ok,
                           key_col.data.astype(jnp.int32), domain)
-        counts_all = OPS.dense_segment_agg(codes, row_ok, codes, S, "count",
-                                           interpret=interp)
+        counts_all = agg_kernel(codes, row_ok, codes, "count")
         count_cache: Dict[str, jnp.ndarray] = {}
 
         def count_of(col_name: str) -> jnp.ndarray:
             if col_name not in count_cache:
                 col = self._cols[col_name]
-                count_cache[col_name] = OPS.dense_segment_agg(
-                    codes, col.valid & row_ok, codes, S, "count",
-                    interpret=interp)
+                count_cache[col_name] = agg_kernel(
+                    codes, col.valid & row_ok, codes, "count")
             return count_cache[col_name]
 
         out: Dict[str, Column] = {}
@@ -491,10 +546,9 @@ class DeviceTable(Table):
             else:  # min / max over int/id
                 col = self._cols[a.col]
                 vals = col.data.astype(jnp.int32)
-                agg = OPS.dense_segment_agg(
-                    codes, col.valid & row_ok, vals, S,
-                    "min_i32" if a.kind == "min" else "max_i32",
-                    interpret=interp)
+                agg = agg_kernel(
+                    codes, col.valid & row_ok, vals,
+                    "min_i32" if a.kind == "min" else "max_i32")
                 has = count_of(a.col) > 0
                 out[a.name] = Column(col.kind, agg.astype(
                     jnp.int64 if col.kind == "int" else jnp.int32),
@@ -703,7 +757,8 @@ class DeviceTableFactory(TableFactory):
             if kind_for(ctype) == "object":
                 local = self._local.from_columns(data, types)
                 return DeviceTable(self.backend, local=local)
-            cols[c] = make_column(list(values), ctype, cap, self.backend.pool)
+            cols[c] = self.backend.place_column(
+                make_column(list(values), ctype, cap, self.backend.pool))
         return DeviceTable(self.backend, cols, n)
 
     def unit(self) -> DeviceTable:

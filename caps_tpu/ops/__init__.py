@@ -12,11 +12,13 @@ the unit suite (CPU, 8 virtual devices) exercises the same kernel code.
 from caps_tpu.ops.segment import (
     dense_segment_agg,
     dense_segment_agg_ref,
+    dense_segment_agg_sharded,
     default_interpret,
 )
 
 __all__ = [
     "dense_segment_agg",
     "dense_segment_agg_ref",
+    "dense_segment_agg_sharded",
     "default_interpret",
 ]

@@ -156,6 +156,40 @@ def dense_segment_agg(codes: jnp.ndarray, ok: jnp.ndarray,
     return out[:num_segments]
 
 
+@functools.lru_cache(maxsize=256)
+def _sharded_agg_fn(mesh, axis: str, num_segments: int, kind: str,
+                    interpret: bool):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(c, o, v):
+        local = dense_segment_agg(c, o, v, num_segments, kind,
+                                  interpret=interpret)
+        if kind.startswith("min"):
+            return jax.lax.pmin(local, axis)
+        if kind.startswith("max"):
+            return jax.lax.pmax(local, axis)
+        return jax.lax.psum(local, axis)
+
+    # check_vma=False: pallas_call outputs don't carry varying-mesh-axis
+    # metadata, so shard_map's vma checker can't see through them.
+    return jax.jit(shard_map(body, mesh=mesh,
+                             in_specs=(P(axis), P(axis), P(axis)),
+                             out_specs=P(), check_vma=False))
+
+
+def dense_segment_agg_sharded(mesh, axis: str, codes, ok, values,
+                              num_segments: int, kind: str,
+                              interpret: bool = False) -> jnp.ndarray:
+    """Distributed histogram: each shard aggregates its row block with the
+    Pallas kernel, partials combine over ICI (psum / pmin / pmax) — the
+    engine's partial-aggregation shuffle (SURVEY.md §5.8).  The jitted
+    shard_map program is cached per (mesh, axis, segments, kind)."""
+    fn = _sharded_agg_fn(mesh, axis, num_segments, kind, interpret)
+    return fn(codes.astype(jnp.int32), ok,
+              values if kind != "count" else codes.astype(jnp.int32))
+
+
 def dense_segment_agg_ref(codes, ok, values, num_segments: int,
                           kind: str) -> jnp.ndarray:
     """jnp reference twin (tests only — SURVEY.md §2 native components)."""

@@ -61,3 +61,26 @@ def test_graft_entry_points():
     total, cnt2 = jax.jit(fn)(*args)
     assert int(total) >= 0
     g.dryrun_multichip(8)
+
+
+def test_ring_khop_matches_reference():
+    """Ring-rotated k-hop expansion (ppermute schedule) vs the dense
+    single-device twin (SURVEY.md §5.7)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from caps_tpu.parallel.mesh import make_mesh
+    from caps_tpu.parallel.ring import make_ring_khop, ring_khop_reference
+
+    n_shards, n_nodes, n_edges, hops = 8, 64, 256, 3
+    rng = np.random.RandomState(7)
+    src = jnp.asarray(rng.randint(0, n_nodes, n_edges, dtype=np.int32))
+    dst = jnp.asarray(rng.randint(0, n_nodes, n_edges, dtype=np.int32))
+    ok = jnp.asarray(rng.rand(n_edges) < 0.9)
+    seed = jnp.asarray((rng.rand(n_nodes) < 0.2).astype(np.int32))
+
+    mesh = make_mesh(n_shards)
+    total, blocks = make_ring_khop(mesh, n_nodes, hops)(seed, src, dst, ok)
+    want_total, want_cnt = ring_khop_reference(seed, src, dst, ok, hops,
+                                               n_nodes)
+    assert int(total) == int(want_total)
+    np.testing.assert_array_equal(np.asarray(blocks), np.asarray(want_cnt))
