@@ -1,0 +1,1 @@
+from caps_tpu.datasets import ldbc  # noqa: F401

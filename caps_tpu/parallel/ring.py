@@ -67,6 +67,15 @@ def make_ring_khop(mesh: Mesh, n_nodes: int, n_hops: int,
     hop = functools.partial(_ring_hop, axis=axis, n_nodes=n_nodes,
                             n_shards=n_shards)
 
+    def check_edges(edge_src, edge_dst, edge_ok):
+        for name, arr in (("edge_src", edge_src), ("edge_dst", edge_dst),
+                          ("edge_ok", edge_ok)):
+            if arr.shape[0] % n_shards:
+                raise ValueError(
+                    f"{name} length {arr.shape[0]} must divide over "
+                    f"{n_shards} shards; pad edges (edge_ok=False) to a "
+                    f"multiple of the shard count")
+
     def body(seed_block, edge_src, edge_dst, edge_ok):
         blk = seed_block
         for _ in range(n_hops):
@@ -78,7 +87,16 @@ def make_ring_khop(mesh: Mesh, n_nodes: int, n_hops: int,
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(), P(axis)))
-    return jax.jit(mapped)
+    jitted = jax.jit(mapped)
+
+    def call(seed_block, edge_src, edge_dst, edge_ok):
+        check_edges(edge_src, edge_dst, edge_ok)
+        if seed_block.shape[0] != n_nodes:
+            raise ValueError(f"seed length {seed_block.shape[0]} != n_nodes "
+                             f"{n_nodes}")
+        return jitted(seed_block, edge_src, edge_dst, edge_ok)
+
+    return call
 
 
 def ring_khop_reference(seed_counts, edge_src, edge_dst, edge_ok,
