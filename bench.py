@@ -34,7 +34,7 @@ def _probe_device(timeout_s: int = 150) -> bool:
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, timeout=timeout_s, text=True)
-        return proc.returncode == 0
+        return proc.returncode == 0 and "cpu" not in proc.stdout
     except subprocess.TimeoutExpired:
         return False
 
@@ -109,6 +109,41 @@ def edges_joined(src, dst, names) -> int:
     return 2 * n_edges + hop1_out + hop2_out
 
 
+def run_triangle_config(on_tpu: bool):
+    """Benchmark config 4 (BASELINE.md): triangle count on an RMAT edge
+    list via the cyclic multiway-join path.  Selected with
+    ``python bench.py triangle [scale]``; the driver's default run stays
+    config 1."""
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.datasets.graph500 import (
+        TRIANGLE_QUERY, count_triangles_reference, triangle_graph,
+    )
+    scale = int(sys.argv[2]) if len(sys.argv) > 2 else 14
+    session = TPUCypherSession()
+    graph, lo, hi = triangle_graph(session, scale=scale, edgefactor=8)
+    run = lambda: graph.cypher(TRIANGLE_QUERY).records.to_maps()[0]["triangles"]
+    got = run()  # warm compile caches
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    # sub-sampled oracle check (full oracle is O(E * avg-deg) host-side)
+    if scale <= 12:
+        assert got == count_triangles_reference(lo, hi)
+    # Edges probed by the three-way join: 3 passes over the edge table.
+    value = 3 * len(lo) / med
+    print(json.dumps({
+        "metric": f"edges-joined/sec, triangle count RMAT scale-{scale} "
+                  f"ef8 ({len(lo)} edges, triangles={got}, "
+                  f"{'tpu' if on_tpu else 'cpu-fallback'})",
+        "value": round(value, 1),
+        "unit": "edges/s",
+        "vs_baseline": 0.0,
+    }))
+
+
 def main():
     import numpy as np
     on_tpu = _probe_device()
@@ -116,6 +151,8 @@ def main():
         print("bench: axon TPU tunnel unreachable; running on CPU",
               file=sys.stderr)
         _force_cpu()
+    if len(sys.argv) > 1 and sys.argv[1] == "triangle":
+        return run_triangle_config(on_tpu)
 
     from caps_tpu.backends.local.session import LocalCypherSession
     from caps_tpu.backends.tpu.session import TPUCypherSession

@@ -348,8 +348,9 @@ COMPLEX_READS: Dict[str, Tuple[str, Callable[[LdbcData, Any], Mapping[str, Any]]
     # ranked by post count (LDBC IC6 ranks co-occurring tags; we have no
     # Tag entity — forums are the closest in-schema analog).
     "IC6": (
-        "MATCH (:Person {id: $personId})-[:KNOWS*1..2]-(f:Person)"
+        "MATCH (s:Person {id: $personId})-[:KNOWS*1..2]-(f:Person)"
         "<-[:HAS_CREATOR]-(p:Post)<-[:CONTAINER_OF]-(fo:Forum) "
+        "WHERE s.id <> f.id "
         "RETURN fo.title AS forumTitle, count(*) AS postCount "
         "ORDER BY postCount DESC, forumTitle ASC LIMIT 10",
         lambda d, rng: {"personId": _rand_person(d, rng)}),

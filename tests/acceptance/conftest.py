@@ -9,24 +9,7 @@ import pytest
 
 from caps_tpu.testing.bag import Bag
 from caps_tpu.testing.factory import create_graph
-
-BACKENDS = ["local", "tpu", "sharded"]
-
-
-def _make_session(backend):
-    if backend == "local":
-        from caps_tpu.backends.local.session import LocalCypherSession
-        return LocalCypherSession()
-    if backend == "tpu":
-        from caps_tpu.backends.tpu.session import TPUCypherSession
-        return TPUCypherSession()
-    if backend == "sharded":
-        # same device backend over an 8-way mesh (virtual CPU devices in
-        # the unit suite — SURVEY.md §4 carry-over (c): mesh size is config)
-        from caps_tpu.backends.tpu.session import TPUCypherSession
-        from caps_tpu.okapi.config import EngineConfig
-        return TPUCypherSession(config=EngineConfig(mesh_shape=(8,)))
-    raise ValueError(backend)
+from caps_tpu.testing.sessions import BACKENDS, make_backend_session as _make_session
 
 
 @pytest.fixture(params=BACKENDS, scope="module")

@@ -29,3 +29,12 @@ except Exception:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def make_session():
+    """Session factory by backend name ('local' | 'tpu' | 'sharded')."""
+    from caps_tpu.testing.sessions import make_backend_session
+    return make_backend_session

@@ -1,0 +1,53 @@
+"""Benchmark config 4: RMAT generation + triangle counting via the cyclic
+multiway-join path, checked against a host-side numpy oracle on both
+backends (BASELINE.md config 4; SURVEY.md §3.2 ExpandInto)."""
+import numpy as np
+import pytest
+
+from caps_tpu.datasets.graph500 import (
+    TRIANGLE_QUERY, count_triangles_reference, rmat_edges, triangle_graph,
+)
+
+
+def test_rmat_deterministic_and_shaped():
+    s1, d1 = rmat_edges(8, edgefactor=4, seed=7)
+    s2, d2 = rmat_edges(8, edgefactor=4, seed=7)
+    assert len(s1) == 4 * 256 == len(d1)
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(d1, d2)
+    assert s1.max() < 256 and d1.max() < 256 and s1.min() >= 0
+
+
+def test_rmat_is_skewed():
+    # RMAT with A=0.57 concentrates mass: max out-degree far above the mean.
+    src, _ = rmat_edges(10, edgefactor=8, seed=3)
+    deg = np.bincount(src, minlength=1 << 10)
+    assert deg.max() >= 8 * deg.mean()
+
+
+def test_reference_triangle_counter():
+    # Known graph: K4 oriented by id has C(4,3)=4 triangles.
+    lo, hi = [], []
+    for u in range(4):
+        for v in range(u + 1, 4):
+            lo.append(u)
+            hi.append(v)
+    assert count_triangles_reference(np.array(lo), np.array(hi)) == 4
+
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_triangle_count_matches_oracle(backend, make_session):
+    session = make_session(backend)
+    graph, lo, hi = triangle_graph(session, scale=6, edgefactor=4, seed=5)
+    want = count_triangles_reference(lo, hi)
+    got = graph.cypher(TRIANGLE_QUERY).records.to_maps()
+    assert got == [{"triangles": want}]
+    assert want > 0  # scale-6 RMAT at ef=4 must actually contain triangles
+
+
+def test_triangle_count_larger_tpu(make_session):
+    session = make_session("tpu")
+    graph, lo, hi = triangle_graph(session, scale=9, edgefactor=8, seed=2)
+    want = count_triangles_reference(lo, hi)
+    got = graph.cypher(TRIANGLE_QUERY).records.to_maps()
+    assert got == [{"triangles": want}]
