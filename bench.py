@@ -86,12 +86,12 @@ def run_query(graph):
     return graph.cypher(QUERY).records.to_maps()[0]["c"]
 
 
-def time_queries(graph, iters: int):
-    run_query(graph)  # warm the compile caches
+def time_fn(run, iters: int):
+    run()  # warm the compile caches
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        run_query(graph)
+        run()
         times.append(time.perf_counter() - t0)
     return statistics.median(times)
 
@@ -122,13 +122,8 @@ def run_triangle_config(on_tpu: bool):
     session = TPUCypherSession()
     graph, lo, hi = triangle_graph(session, scale=scale, edgefactor=8)
     run = lambda: graph.cypher(TRIANGLE_QUERY).records.to_maps()[0]["triangles"]
-    got = run()  # warm compile caches
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - t0)
-    med = statistics.median(times)
+    got = run()
+    med = time_fn(run, iters=5)
     # sub-sampled oracle check (full oracle is O(E * avg-deg) host-side)
     if scale <= 12:
         assert got == count_triangles_reference(lo, hi)
@@ -164,7 +159,7 @@ def main():
     graph, src, dst, names = build_graph(tpu_session, n_people, n_edges,
                                          n_seeds, rng)
     expected = run_query(graph)
-    med = time_queries(graph, iters=10)
+    med = time_fn(lambda: run_query(graph), iters=10)
     work = edges_joined(src, dst, names)
     value = work / med
     fallbacks = tpu_session.fallback_count

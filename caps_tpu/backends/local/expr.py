@@ -171,6 +171,11 @@ class _Evaluator:
                 out.append(True if found else (None if has_null or
                                                (a is None and len(lst) > 0) else False))
             return out
+        if isinstance(e, E.Disjoint):
+            l, r = self.eval(e.lhs), self.eval(e.rhs)
+            return [None if a is None or b is None
+                    else not (set(a) & set(b))
+                    for a, b in zip(l, r)]
         if isinstance(e, E.StartsWith):
             return self._strpred(e, lambda a, b: a.startswith(b))
         if isinstance(e, E.EndsWith):

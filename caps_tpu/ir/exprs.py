@@ -234,6 +234,14 @@ class In(BinaryExpr):
 
 
 @dataclasses.dataclass(frozen=True)
+class Disjoint(BinaryExpr):
+    """True iff the two list operands share no element — planner-internal,
+    emitted for relationship-uniqueness between two var-length rel lists
+    in one MATCH pattern (Cypher edge isomorphism; no surface syntax)."""
+    op = "DISJOINT"
+
+
+@dataclasses.dataclass(frozen=True)
 class StartsWith(BinaryExpr):
     op = "STARTS WITH"
 

@@ -97,7 +97,8 @@ class SchemaTyper:
 
         if isinstance(e, (E.Equals, E.NotEquals, E.LessThan, E.LessThanOrEqual,
                           E.GreaterThan, E.GreaterThanOrEqual, E.In,
-                          E.StartsWith, E.EndsWith, E.Contains, E.RegexMatch)):
+                          E.Disjoint, E.StartsWith, E.EndsWith, E.Contains,
+                          E.RegexMatch)):
             lt, rt = rec(e.lhs), rec(e.rhs)
             nullable = (lt.is_nullable or rt.is_nullable
                         or lt == CTNull or rt == CTNull)

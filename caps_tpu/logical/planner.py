@@ -71,6 +71,15 @@ class LogicalPlanner:
         return L.LogicalPlan(op, result_fields, returns_graph)
 
 
+def _rel_types_of(ct: CypherType) -> frozenset:
+    """Declared rel types of a rel var (CTRelationship) or var-length rel
+    var (CTList(CTRelationship))."""
+    m = ct.material
+    if isinstance(m, _CTList):
+        m = m.inner.material
+    return m.rel_types if isinstance(m, _CTRelationship) else frozenset()
+
+
 class _QueryPlanner:
     def __init__(self, parent: LogicalPlanner):
         self.parent = parent
@@ -186,6 +195,16 @@ class _QueryPlanner:
                                            for f in pattern.entities}
         solved = set(op.field_names)
         pending = list(pattern.connections)
+        # Rel vars newly bound by THIS pattern: Cypher edge isomorphism
+        # requires pairwise-distinct relationships per MATCH.  VarExpand
+        # dedups hops within its own path only; cross-connection pairs get
+        # explicit uniqueness filters below.
+        fixed_rels: List[str] = [
+            c.rel for c in pending
+            if not c.is_var_length and c.rel not in solved]
+        var_rels: List[str] = [
+            c.rel for c in pending
+            if c.is_var_length and c.rel not in solved]
         # Node entities that must be scanned (not produced by an expansion)
         node_vars = [f.name for f in pattern.entities
                      if isinstance(f.cypher_type.material, _CTNode)]
@@ -256,4 +275,29 @@ class _QueryPlanner:
                 raise LogicalPlanningError(
                     f"cannot solve pattern: connections {pending} reference "
                     "no bound or scannable variable")
+        # Edge-isomorphism filters for rel pairs whose declared type sets
+        # could overlap (disjoint non-empty sets can never collide):
+        #   fixed-fixed: id(r1) <> id(r2)
+        #   fixed-var:   NOT id(r1) IN r_var   (var rel binds a rel list)
+        #   var-var:     DISJOINT(r1, r2)      (planner-internal expr)
+        def could_overlap(r1: str, r2: str) -> bool:
+            t1 = _rel_types_of(declared[r1])
+            t2 = _rel_types_of(declared[r2])
+            return not (t1 and t2 and not (set(t1) & set(t2)))
+
+        for i, r1 in enumerate(fixed_rels):
+            for r2 in fixed_rels[i + 1:]:
+                if could_overlap(r1, r2):
+                    pred = E.Not(E.Equals(E.Id(E.Var(r1)), E.Id(E.Var(r2))))
+                    op = L.Filter(op, pred, fields=op.fields)
+        for rf in fixed_rels:
+            for rv in var_rels:
+                if could_overlap(rf, rv):
+                    pred = E.Not(E.In(E.Id(E.Var(rf)), E.Var(rv)))
+                    op = L.Filter(op, pred, fields=op.fields)
+        for i, r1 in enumerate(var_rels):
+            for r2 in var_rels[i + 1:]:
+                if could_overlap(r1, r2):
+                    op = L.Filter(op, E.Disjoint(E.Var(r1), E.Var(r2)),
+                                  fields=op.fields)
         return op

@@ -58,17 +58,19 @@ def _agg_kernel(codes_ref, ok_ref, val_ref, out_ref, *, kind: str,
                 row_tile: int, seg_tile: int):
     i = pl.program_id(1)  # row tile (innermost: out block stays resident)
     j = pl.program_id(0)
-    codes = codes_ref[:]
-    ok = ok_ref[:] != 0
     seg = j * seg_tile + jax.lax.broadcasted_iota(
         jnp.int32, (row_tile, seg_tile), 1)
-    hit = (codes.reshape(row_tile, 1) == seg) & ok.reshape(row_tile, 1)
+    # reshape the int32 refs BEFORE comparing: Mosaic cannot insert a minor
+    # dim on i1 vectors ("only supported for 32-bit types")
+    codes2d = codes_ref[:].reshape(row_tile, 1)
+    ok2d = ok_ref[:].reshape(row_tile, 1) != 0
+    hit = (codes2d == seg) & ok2d
     # NB: dtype= on the reductions — x64 mode is enabled globally and the
     # default int32→int64 promotion does not lower on Mosaic TPU.
     if kind == "count":
         part = jnp.sum(hit.astype(jnp.int32), axis=0, dtype=jnp.int32)
     elif kind == "sum_f32":
-        v = jnp.where(ok, val_ref[:], jnp.float32(0))
+        v = jnp.where(ok_ref[:] != 0, val_ref[:], jnp.float32(0))
         part = jnp.dot(v.reshape(1, row_tile), hit.astype(jnp.float32),
                        preferred_element_type=jnp.float32).reshape(seg_tile)
     elif kind == "sum_i32":

@@ -1,0 +1,35 @@
+"""TCK-subset conformance: every scenario in caps_tpu/tck/features runs
+against all backends, with per-backend blacklists (SURVEY.md §4.3 — the
+reference's okapi-tck cucumber runner + failing_blacklist mechanism)."""
+import os
+
+import pytest
+
+from caps_tpu.tck import load_blacklist, load_features, run_scenario
+from caps_tpu.tck.runner import FEATURES_DIR
+from caps_tpu.testing.sessions import BACKENDS, make_backend_session
+
+SCENARIOS = load_features()
+_BL_DIR = os.path.join(os.path.dirname(FEATURES_DIR), "blacklists")
+
+_SESSIONS = {}
+
+
+def _session(backend):
+    if backend not in _SESSIONS:
+        _SESSIONS[backend] = make_backend_session(backend)
+    return _SESSIONS[backend]
+
+
+def test_corpus_is_nontrivial():
+    assert len(SCENARIOS) >= 60
+    assert len({s.feature for s in SCENARIOS}) >= 8
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("scenario", SCENARIOS, ids=lambda s: s.key)
+def test_tck(backend, scenario):
+    blacklist = load_blacklist(os.path.join(_BL_DIR, f"{backend}.txt"))
+    if scenario.key in blacklist:
+        pytest.xfail(f"blacklisted for {backend}")
+    run_scenario(_session(backend), scenario)
