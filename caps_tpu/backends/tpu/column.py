@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from caps_tpu import native
 from caps_tpu.okapi.types import (
     CTBoolean, CTFloat, CTInteger, CTNumber, CTString, CypherType, _CTList,
     _CTNode, _CTRelationship,
@@ -101,25 +102,65 @@ def make_column(values: List[Any], ctype: CypherType, capacity: int,
                       ctype, jnp.asarray(lens_np))
     dtype = _DTYPES[kind]
     data_np = np.zeros(capacity, dtype=np.dtype(dtype))
+    if kind == "str":
+        codes = np.asarray(pool.encode_many(list(values)), dtype=np.int32)
+        data_np[:n] = np.where(codes >= 0, codes, 0)
+        valid_np[:n] = codes >= 0
+        return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np),
+                      ctype)
+    fast = _make_column_native(values, kind, n)
+    if fast is not None:
+        d, v = fast
+        data_np[:n] = d
+        valid_np[:n] = v
+        return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np),
+                      ctype)
     for i, v in enumerate(values):
         if v is None:
             continue
         valid_np[i] = True
-        if kind == "str":
-            data_np[i] = pool.encode(v)
-        elif kind == "bool":
+        if kind == "bool":
             data_np[i] = bool(v)
         elif kind == "id":
-            iv = int(v)
-            if not (-2**31 < iv < 2**31):
-                raise ValueError(f"entity id {iv} exceeds int32 (ingest "
-                                 "should densify ids)")
-            data_np[i] = iv
+            data_np[i] = _check_id(int(v))
         elif kind == "float":
             data_np[i] = float(v)
         else:
             data_np[i] = int(v)
     return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np), ctype)
+
+
+def _check_id(iv: int) -> int:
+    if not (-2**31 < iv < 2**31):
+        raise ValueError(f"entity id {iv} exceeds int32 (ingest "
+                         "should densify ids)")
+    return iv
+
+
+def _make_column_native(values, kind: str, n: int):
+    """Bulk ingest via the C++ host runtime (csrc/host_runtime.cpp); returns
+    (data, valid) numpy views of length n, or None to use the Python loop.
+    str columns never reach here — make_column returns early via
+    pool.encode_many (itself native-backed when available)."""
+    if native.lib is None or n == 0:
+        return None
+    if kind in ("int", "id"):
+        raw_d, raw_v = native.lib.ingest_i64(values)
+        d = np.frombuffer(raw_d, np.int64)
+        if kind == "id":
+            if len(d):
+                _check_id(int(d.max()))
+                _check_id(int(d.min()))
+            d = d.astype(np.int32)
+    elif kind == "float":
+        raw_d, raw_v = native.lib.ingest_f64(values)
+        d = np.frombuffer(raw_d, np.float64)
+    elif kind == "bool":
+        raw_d, raw_v = native.lib.ingest_bool(values)
+        d = np.frombuffer(raw_d, np.uint8).astype(bool)
+    else:
+        return None
+    return d, np.frombuffer(raw_v, np.uint8).astype(bool)
 
 
 def column_to_host(col: Column, n: int, pool) -> List[Any]:

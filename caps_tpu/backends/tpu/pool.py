@@ -15,7 +15,15 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from caps_tpu import native
+
 NULL_CODE = -1
+
+
+def make_pool() -> "StringPool":
+    """Native-backed pool when the C++ host runtime is available
+    (csrc/host_runtime.cpp), pure Python otherwise."""
+    return NativeStringPool() if native.available() else StringPool()
 
 
 class StringPool:
@@ -99,5 +107,76 @@ class StringPool:
             out = np.empty(size, dtype=np.int32)
             for code in range(size):
                 out[code] = self.encode(fn(self._strings[code]))
+            self._fn_luts[key] = out
+        return self._fn_luts[key]
+
+
+class NativeStringPool(StringPool):
+    """StringPool over the C++ host runtime: bulk encode/decode and rank
+    run natively; the LUT builders reuse the base-class logic against a
+    snapshot of the native pool's strings.
+
+    ``_strings``/``_codes`` from the base class are unused; the native
+    pool (a handle into _caps_host) is the single source of truth."""
+
+    def __init__(self):
+        super().__init__()
+        self._h = native.lib.pool_new()
+
+    def __del__(self):  # pragma: no cover - interpreter teardown timing
+        try:
+            native.lib.pool_free(self._h)
+        except Exception:
+            pass
+
+    def __len__(self) -> int:
+        return native.lib.pool_size(self._h)
+
+    @property
+    def version(self) -> int:
+        return native.lib.pool_size(self._h)
+
+    def encode(self, s: Optional[str]) -> int:
+        return native.lib.pool_encode1(self._h, s)
+
+    def encode_many(self, values) -> np.ndarray:
+        if not isinstance(values, (list, tuple)):
+            values = list(values)
+        raw = native.lib.pool_encode_many(self._h, values)
+        return np.frombuffer(raw, dtype=np.int32)
+
+    def decode(self, code: int) -> Optional[str]:
+        return native.lib.pool_get(self._h, int(code))
+
+    def decode_many(self, codes) -> List[Optional[str]]:
+        get = native.lib.pool_get
+        h = self._h
+        return [get(h, int(c)) for c in codes]
+
+    def _snapshot(self) -> List[str]:
+        strings = native.lib.pool_get_all(self._h)
+        self._strings = strings  # base-class LUT builders read this
+        return strings
+
+    def rank_array(self) -> np.ndarray:
+        if self._rank_version != self.version:
+            self._rank = np.frombuffer(native.lib.pool_rank(self._h),
+                                       dtype=np.int32).copy()
+            self._rank_version = self.version
+            self._fn_luts.clear()
+        return self._rank
+
+    def predicate_lut(self, fn: Callable[[str], bool]) -> np.ndarray:
+        strings = self._snapshot()
+        return np.array([bool(fn(s)) for s in strings], dtype=bool) \
+            if strings else np.zeros(0, dtype=bool)
+
+    def map_lut(self, name: str, fn: Callable[[str], str]) -> np.ndarray:
+        key = (name, self.version)
+        if key not in self._fn_luts:
+            strings = self._snapshot()
+            out = np.empty(len(strings), dtype=np.int32)
+            for code, s in enumerate(strings):
+                out[code] = self.encode(fn(s))
             self._fn_luts[key] = out
         return self._fn_luts[key]

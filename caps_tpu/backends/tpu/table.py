@@ -28,7 +28,7 @@ from caps_tpu.backends.tpu.column import (
     Column, column_to_host, kind_for, literal_column, make_column,
 )
 from caps_tpu.backends.tpu.expr import DeviceExprCompiler, UnsupportedOnDevice
-from caps_tpu.backends.tpu.pool import StringPool
+from caps_tpu.backends.tpu.pool import make_pool
 from caps_tpu.ir.exprs import Expr
 from caps_tpu.okapi.config import EngineConfig
 from caps_tpu.okapi.types import CTBoolean, CTInteger, CypherType
@@ -49,7 +49,7 @@ class DeviceBackend:
     """
 
     def __init__(self, config: EngineConfig):
-        self.pool = StringPool()
+        self.pool = make_pool()
         self.config = config
         self.fallbacks = 0
         self.fallback_reasons: List[str] = []

@@ -102,12 +102,27 @@ TRIANGLE_QUERY = ("MATCH (a)-[:E]->(b)-[:E]->(c), (a)-[:E]->(c) "
 
 
 def count_triangles_reference(lo: np.ndarray, hi: np.ndarray) -> int:
-    """Host-side oracle: count triangles in the oriented edge list with
-    numpy (sorted adjacency + per-edge sorted intersection)."""
-    order = np.lexsort((hi, lo))
-    lo_s, hi_s = lo[order], hi[order]
-    n = int(max(lo_s.max(initial=-1), hi_s.max(initial=-1))) + 1 if len(lo_s) else 0
-    starts = np.searchsorted(lo_s, np.arange(n + 1))
+    """Host-side oracle: count triangles in the oriented edge list via a
+    CSR adjacency (built by the C++ host runtime when available —
+    csrc/host_runtime.cpp csr_build; numpy counting sort otherwise) + a
+    per-edge sorted neighbour intersection."""
+    from caps_tpu import native
+    if len(lo) == 0:
+        return 0
+    n = int(max(lo.max(), hi.max())) + 1
+    lo64, hi64 = lo.astype(np.int64), hi.astype(np.int64)
+    if native.available():
+        off_b, perm_b = native.lib.csr_build(
+            np.ascontiguousarray(lo64).tobytes(), len(lo64), n)
+        starts = np.frombuffer(off_b, np.int64)
+        perm = np.frombuffer(perm_b, np.int64)
+    else:
+        starts = np.concatenate(
+            [[0], np.cumsum(np.bincount(lo64, minlength=n))])
+        perm = np.argsort(lo64, kind="stable")
+    # rows grouped by source via perm; intersect1d sorts internally so
+    # within-row neighbour order doesn't matter
+    lo_s, hi_s = lo64[perm], hi64[perm]
     total = 0
     for u, v in zip(lo_s, hi_s):
         au = hi_s[starts[u]:starts[u + 1]]

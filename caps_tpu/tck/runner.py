@@ -46,13 +46,15 @@ class FeatureParseError(Exception):
 
 
 def _parse_docstring(lines: List[str], i: int) -> Tuple[str, int]:
-    if lines[i].strip() != '"""':
+    if i >= len(lines) or lines[i].strip() != '"""':
         raise FeatureParseError(f'expected """ at line {i + 1}')
     i += 1
     body = []
     while lines[i].strip() != '"""':
         body.append(lines[i].strip())
         i += 1
+        if i >= len(lines):
+            raise FeatureParseError("unterminated docstring")
     return " ".join(body).strip(), i + 1
 
 
