@@ -144,21 +144,27 @@ def _make_column_native(values, kind: str, n: int):
     pool.encode_many (itself native-backed when available)."""
     if native.lib is None or n == 0:
         return None
-    if kind in ("int", "id"):
-        raw_d, raw_v = native.lib.ingest_i64(values)
-        d = np.frombuffer(raw_d, np.int64)
-        if kind == "id":
-            if len(d):
-                _check_id(int(d.max()))
-                _check_id(int(d.min()))
-            d = d.astype(np.int32)
-    elif kind == "float":
-        raw_d, raw_v = native.lib.ingest_f64(values)
-        d = np.frombuffer(raw_d, np.float64)
-    elif kind == "bool":
-        raw_d, raw_v = native.lib.ingest_bool(values)
-        d = np.frombuffer(raw_d, np.uint8).astype(bool)
-    else:
+    try:
+        if kind in ("int", "id"):
+            raw_d, raw_v = native.lib.ingest_i64(values)
+            d = np.frombuffer(raw_d, np.int64)
+            if kind == "id":
+                if len(d):
+                    _check_id(int(d.max()))
+                    _check_id(int(d.min()))
+                d = d.astype(np.int32)
+        elif kind == "float":
+            raw_d, raw_v = native.lib.ingest_f64(values)
+            d = np.frombuffer(raw_d, np.float64)
+        elif kind == "bool":
+            raw_d, raw_v = native.lib.ingest_bool(values)
+            d = np.frombuffer(raw_d, np.uint8).astype(bool)
+        else:
+            return None
+    except (TypeError, ValueError, OverflowError):
+        # values the strict C converters reject (e.g. numeric strings) —
+        # fall back to the Python loop so semantics never depend on
+        # whether the toolchain was present
         return None
     return d, np.frombuffer(raw_v, np.uint8).astype(bool)
 

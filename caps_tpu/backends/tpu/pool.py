@@ -167,16 +167,9 @@ class NativeStringPool(StringPool):
         return self._rank
 
     def predicate_lut(self, fn: Callable[[str], bool]) -> np.ndarray:
-        strings = self._snapshot()
-        return np.array([bool(fn(s)) for s in strings], dtype=bool) \
-            if strings else np.zeros(0, dtype=bool)
+        self._snapshot()
+        return super().predicate_lut(fn)
 
     def map_lut(self, name: str, fn: Callable[[str], str]) -> np.ndarray:
-        key = (name, self.version)
-        if key not in self._fn_luts:
-            strings = self._snapshot()
-            out = np.empty(len(strings), dtype=np.int32)
-            for code, s in enumerate(strings):
-                out[code] = self.encode(fn(s))
-            self._fn_luts[key] = out
-        return self._fn_luts[key]
+        self._snapshot()
+        return super().map_lut(name, fn)

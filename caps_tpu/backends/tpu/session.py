@@ -27,6 +27,26 @@ class TPUCypherSession(RelationalCypherSession):
     def fallback_count(self) -> int:
         return self.backend.fallbacks
 
+    def health_check(self) -> dict:
+        """Device health probe (SURVEY.md §5.3): run a tiny canary program
+        on every device of the session's mesh (or the default device) and
+        verify the arithmetic.  Returns {device_str: bool}.  A failed or
+        crashing device reports False rather than raising, so callers can
+        shrink the mesh and re-shard."""
+        import jax
+        import jax.numpy as jnp
+        devices = (list(self.backend.mesh.devices.flat)
+                   if self.backend.mesh is not None else [jax.devices()[0]])
+        status = {}
+        for d in devices:
+            try:
+                x = jax.device_put(jnp.arange(8, dtype=jnp.int32), d)
+                ok = int((x * 2 + 1).sum()) == 64
+            except Exception:
+                ok = False
+            status[str(d)] = ok
+        return status
+
     @staticmethod
     def local(**kwargs) -> "TPUCypherSession":
         return TPUCypherSession(**kwargs)

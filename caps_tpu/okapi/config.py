@@ -50,6 +50,10 @@ class EngineConfig:
     # Compile-cache capacity (query programs keyed by plan+bucket shapes)
     compile_cache_size: int = dataclasses.field(
         default_factory=lambda: _env_int("CAPS_TPU_COMPILE_CACHE", 512))
+    # Determinism check (SURVEY.md §5.2): run each query twice and compare
+    # result digests; raises NondeterministicResultError on mismatch.
+    determinism_check: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_DETERMINISM_CHECK", False))
 
     def bucket_for(self, n: int) -> int:
         for b in self.bucket_sizes:

@@ -11,7 +11,14 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import time
+from contextlib import nullcontext
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+try:  # profiling is optional — this layer stays backend-agnostic
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover
+    _TraceAnnotation = None
 
 from caps_tpu.ir import exprs as E
 from caps_tpu.okapi.types import (
@@ -29,6 +36,9 @@ class RelationalRuntimeContext:
     def __init__(self, session, parameters: Optional[Mapping[str, Any]] = None):
         self.session = session
         self.parameters: Dict[str, Any] = dict(parameters or {})
+        # per-operator wall-clock + row counts, filled as ops evaluate
+        # (SURVEY.md §5.1 — the structured analog of the Spark UI stage view)
+        self.op_metrics: List[Dict[str, Any]] = []
 
     @property
     def factory(self):
@@ -89,7 +99,17 @@ class RelationalOperator(abc.ABC):
     @property
     def result(self) -> Tuple[RecordHeader, Table]:
         if self._result is None:
-            self._result = self._compute()
+            name = type(self).__name__.removesuffix("Op")
+            t0 = time.perf_counter()
+            span = (_TraceAnnotation(f"caps_tpu.{name}")
+                    if _TraceAnnotation is not None else nullcontext())
+            with span:
+                self._result = self._compute()
+            self.context.op_metrics.append({
+                "op": name,
+                "seconds": time.perf_counter() - t0,
+                "rows": self._result[1].size,
+            })
         return self._result
 
     @property

@@ -8,8 +8,12 @@ SURVEY.md §2, §3.1).
 from __future__ import annotations
 
 import abc
+import hashlib
+import logging
 import time
 from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+logger = logging.getLogger("caps_tpu")
 
 from caps_tpu.frontend.parser import parse_query
 from caps_tpu.ir import blocks as B
@@ -32,6 +36,22 @@ from caps_tpu.relational.graphs import EmptyGraph, RelationalCypherGraph, ScanGr
 from caps_tpu.relational.header import RecordHeader
 from caps_tpu.relational.planner import RelationalPlanner
 from caps_tpu.relational.table import Table, TableFactory
+
+
+class NondeterministicResultError(RuntimeError):
+    """Raised by the determinism check (EngineConfig.determinism_check)
+    when a replayed query yields a different result multiset."""
+
+
+def result_digest(result: "CypherResult") -> str:
+    """Order-insensitive sha256 of a result's rows (multiset digest):
+    per-row digests are sorted before hashing, so any valid row order
+    yields the same digest."""
+    rows = result.to_maps()
+    row_digests = sorted(
+        hashlib.sha256(repr(sorted(r.items())).encode()).hexdigest()
+        for r in rows)
+    return hashlib.sha256("".join(row_digests).encode()).hexdigest()
 
 
 class RelationalCypherRecords(CypherRecords):
@@ -197,6 +217,23 @@ class RelationalCypherSession(CypherSession):
     def cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
                         parameters: Optional[Mapping[str, Any]] = None
                         ) -> CypherResult:
+        result = self._cypher_on_graph(graph, query, parameters)
+        if self.config.determinism_check and result.records is not None:
+            # SURVEY.md §5.2: deterministic replay — run the same query a
+            # second time and compare multiset digests of the results.
+            again = self._cypher_on_graph(graph, query, parameters)
+            d1 = result_digest(result)
+            d2 = result_digest(again)
+            if d1 != d2:
+                raise NondeterministicResultError(
+                    f"query produced different results on replay "
+                    f"({d1[:12]} vs {d2[:12]}): {query!r}")
+            result.metrics["determinism_digest"] = d1
+        return result
+
+    def _cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
+                         parameters: Optional[Mapping[str, Any]] = None
+                         ) -> CypherResult:
         t0 = time.perf_counter()
         params = dict(parameters or {})
         stmt = parse_query(query)
@@ -245,9 +282,12 @@ class RelationalCypherSession(CypherSession):
             "parse_s": t1 - t0, "ir_s": t2 - t1, "plan_s": t3 - t2,
             "relational_s": t4 - t3, "execute_s": t5 - t4,
             "rows": records.size() if records is not None else 0,
+            "operators": context.op_metrics,
         }
         if self.config.print_timings:
             print(f"[caps-tpu] timings: {metrics}")
+        logger.debug("query %r: %d rows in %.1f ms", query,
+                     metrics["rows"], 1e3 * (t5 - t0))
         return RelationalCypherResult(records, result_graph, plans, metrics)
 
     # -- graph-returning statements -----------------------------------------

@@ -50,12 +50,13 @@ def _parse_docstring(lines: List[str], i: int) -> Tuple[str, int]:
         raise FeatureParseError(f'expected """ at line {i + 1}')
     i += 1
     body = []
-    while lines[i].strip() != '"""':
-        body.append(lines[i].strip())
-        i += 1
+    while True:
         if i >= len(lines):
             raise FeatureParseError("unterminated docstring")
-    return " ".join(body).strip(), i + 1
+        if lines[i].strip() == '"""':
+            return " ".join(body).strip(), i + 1
+        body.append(lines[i].strip())
+        i += 1
 
 
 def _parse_table(lines: List[str], i: int) -> Tuple[List[List[str]], int]:

@@ -1,0 +1,38 @@
+"""Filesystem data-source example — store a graph to Parquet, register
+the directory as a catalog namespace, query it back with FROM GRAPH
+(ref: spark-cypher FSGraphSource / Neo4jWorkflowExample workflow shape —
+reconstructed, mount empty; SURVEY.md §2, §3.3).
+
+Run:  python examples/fs_datasource.py
+"""
+import tempfile
+
+import caps_tpu
+from caps_tpu.io.fs import FSGraphSource
+from caps_tpu.okapi.graph import GraphName
+from caps_tpu.testing.factory import create_graph
+
+
+def main(backend: str = "tpu"):
+    session = caps_tpu.local_session(backend=backend)
+    graph = create_graph(session, """
+        CREATE (:City {name: 'Kyoto', pop: 1463723}),
+               (:City {name: 'Oslo', pop: 709037})
+    """)
+
+    with tempfile.TemporaryDirectory() as root:
+        fs = FSGraphSource(session, root, fmt="parquet")
+        session.catalog.register_source("fs", fs)
+        fs.store(GraphName("cities"), graph)
+
+        rows = session.cypher("""
+            FROM GRAPH fs.cities
+            MATCH (c:City) WHERE c.pop > 1000000
+            RETURN c.name AS n
+        """).records.to_maps()
+        print("big cities from the fs source:", [r["n"] for r in rows])
+        return rows
+
+
+if __name__ == "__main__":
+    main()

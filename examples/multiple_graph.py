@@ -1,0 +1,49 @@
+"""Multiple-graph example — catalog, CONSTRUCT, RETURN GRAPH, FROM GRAPH
+(benchmark config 5; ref: spark-cypher-examples MultipleGraphExample —
+reconstructed, mount empty; SURVEY.md §2, §3.4).
+
+Run:  python examples/multiple_graph.py
+"""
+import caps_tpu
+from caps_tpu.testing.factory import create_graph
+
+
+def main(backend: str = "tpu"):
+    session = caps_tpu.local_session(backend=backend)
+
+    social = create_graph(session, """
+        CREATE (a:Person {name: 'Alice'}), (b:Person {name: 'Bob'}),
+               (a)-[:KNOWS]->(b)
+    """)
+    purchases = create_graph(session, """
+        CREATE (a:Person {name: 'Alice'}), (p:Product {title: 'book'}),
+               (a)-[:BOUGHT]->(p)
+    """)
+    session.catalog.store("social", social)
+    session.catalog.store("purchases", purchases)
+
+    # Query a catalog graph by name
+    rows = session.cypher("""
+        FROM GRAPH session.social
+        MATCH (p:Person) RETURN p.name AS n ORDER BY n
+    """).records.to_maps()
+    print("people in session.social:", [r["n"] for r in rows])
+
+    # CONSTRUCT a recommendation graph linking friends to what they bought
+    result = session.cypher("""
+        FROM GRAPH session.social
+        MATCH (a:Person)-[:KNOWS]->(b:Person)
+        CONSTRUCT
+          NEW (a)-[:SHOULD_ASK]->(b)
+        RETURN GRAPH
+    """)
+    rec = result.graph
+    edges = rec.cypher("""
+        MATCH (x)-[:SHOULD_ASK]->(y) RETURN x.name AS x, y.name AS y
+    """).records.to_maps()
+    print("constructed SHOULD_ASK edges:", edges)
+    return rows, edges
+
+
+if __name__ == "__main__":
+    main()

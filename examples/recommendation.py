@@ -1,0 +1,46 @@
+"""Recommendation example — multi-hop collaborative filtering in one
+Cypher query (ref: spark-cypher-examples RecommendationExample —
+reconstructed, mount empty; SURVEY.md §2).
+
+Customers who bought the same product as you are taste-neighbours; rank
+what they bought that you haven't.
+
+Run:  python examples/recommendation.py
+"""
+import caps_tpu
+from caps_tpu.testing.factory import create_graph
+
+
+def main(backend: str = "tpu"):
+    session = caps_tpu.local_session(backend=backend)
+    graph = create_graph(session, """
+        CREATE (nia:Customer {name: 'Nia'}),
+               (omar:Customer {name: 'Omar'}),
+               (vera:Customer {name: 'Vera'}),
+               (kb:Product {title: 'keyboard'}),
+               (ms:Product {title: 'mouse'}),
+               (mn:Product {title: 'monitor'}),
+               (hd:Product {title: 'headset'}),
+               (nia)-[:BOUGHT]->(kb), (nia)-[:BOUGHT]->(ms),
+               (omar)-[:BOUGHT]->(kb), (omar)-[:BOUGHT]->(mn),
+               (vera)-[:BOUGHT]->(ms), (vera)-[:BOUGHT]->(mn),
+               (vera)-[:BOUGHT]->(hd)
+    """)
+    rows = graph.cypher("""
+        MATCH (me:Customer {name: 'Nia'})-[:BOUGHT]->(:Product)
+              <-[:BOUGHT]-(peer:Customer)-[:BOUGHT]->(rec:Product)
+        WHERE peer.name <> 'Nia'
+        OPTIONAL MATCH (me)-[own:BOUGHT]->(rec)
+        WITH rec, count(*) AS score, count(own) AS owned
+        WHERE owned = 0
+        RETURN rec.title AS recommend, score
+        ORDER BY score DESC, recommend
+    """).records.to_maps()
+    print("recommendations for Nia:")
+    for r in rows:
+        print(f"  {r['recommend']} (score {r['score']})")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Examples must stay runnable — the analog of the reference's
+documentation module compiling its snippet sources (SURVEY.md §4.5).
+Each example's main() returns its result rows so we can assert content,
+not just exit status."""
+import os
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+sys.path.insert(0, EXAMPLES_DIR)
+
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_social_network(backend):
+    import social_network
+    rows, foaf = social_network.main(backend)
+    assert rows == [{"a": "Alice", "b": "Bob"}, {"a": "Alice", "b": "Carol"}]
+    assert foaf == [{"foaf": "Carol"}]
+
+
+def test_columnar_input():
+    import columnar_input
+    rows = columnar_input.main()
+    assert rows == [{"customer": "Nia", "total": 298.0},
+                    {"customer": "Omar", "total": 19.0}]
+
+
+def test_multiple_graph():
+    import multiple_graph
+    people, edges = multiple_graph.main()
+    assert [r["n"] for r in people] == ["Alice", "Bob"]
+    assert edges == [{"x": "Alice", "y": "Bob"}]
+
+
+def test_recommendation():
+    import recommendation
+    rows = recommendation.main()
+    assert rows == [{"recommend": "monitor", "score": 2},
+                    {"recommend": "headset", "score": 1}]
+
+
+def test_fs_datasource():
+    import fs_datasource
+    rows = fs_datasource.main()
+    assert rows == [{"n": "Kyoto"}]
