@@ -691,6 +691,15 @@ class CypherParser:
             self.expect_sym(")")
             return inner
         if t.kind == IDENT:
+            if t.text.upper() == "EXISTS" and self.peek(1).kind == SYM \
+                    and self.peek(1).text == "{":
+                self.advance()  # EXISTS
+                self.advance()  # {
+                self.accept_kw("MATCH")  # the MATCH keyword is optional
+                pattern = self.parse_pattern()
+                where = self.parse_expr() if self.accept_kw("WHERE") else None
+                self.expect_sym("}")
+                return E.ExistsSubQuery(pattern, where)
             if self.peek(1).kind == SYM and self.peek(1).text == "(":
                 name = self.advance().text
                 self.advance()  # '('

@@ -49,12 +49,31 @@ def _pattern_vars(pattern: ast.Pattern) -> Set[str]:
 
 def _check_expr_vars(expr: E.Expr, scope: Set[str], where: str) -> None:
     local = set()
+    # comprehension vars first: they are visible anywhere in this expr
     for n in expr.walk():
+        if isinstance(n, E.ExistsSubQuery):
+            continue  # its own scope — checked recursively below
         if isinstance(n, E.ListComprehension):
-            local.add(n.var)  # comprehension variable is locally bound
-    for v in E.vars_in(expr):
-        if v.name not in scope and v.name not in local:
-            raise CypherSemanticError(f"variable `{v.name}` not defined ({where})")
+            local.add(n.var)
+
+    def check(n: E.Expr) -> None:
+        if isinstance(n, E.ExistsSubQuery):
+            # EXISTS pattern vars are visible ONLY inside the subquery
+            inner = scope | local | (_pattern_vars(n.pattern)
+                                     if isinstance(n.pattern, ast.Pattern)
+                                     else set())
+            if n.where is not None:
+                _check_expr_vars(n.where, inner, where)
+            return
+        if isinstance(n, E.Var) and n.name not in scope \
+                and n.name not in local:
+            raise CypherSemanticError(
+                f"variable `{n.name}` not defined ({where})")
+        for c in n.children:
+            if isinstance(c, E.Expr):
+                check(c)
+
+    check(expr)
 
 
 def _check_no_aggregation(expr: E.Expr, where: str) -> None:

@@ -133,6 +133,20 @@ class _SingleQueryBuilder:
         connections: List[Connection] = []
         bound: List[str] = []
         predicates: List[E.Expr] = []
+        self._build_pattern(clause.pattern, entities, connections, bound,
+                            predicates)
+        if clause.where is not None:
+            predicates.extend(self._split_ands(clause.where))
+        predicates = [self._resolve_exists(p) for p in predicates]
+        self.blocks.append(MatchBlock(
+            Pattern(tuple(entities), tuple(connections), tuple(bound)),
+            tuple(predicates), clause.optional))
+
+    def _build_pattern(self, pattern: ast.Pattern, entities: List[IRField],
+                       connections: List[Connection], bound: List[str],
+                       predicates: List[E.Expr]) -> None:
+        """Declare an AST pattern's entities into the current env, emitting
+        connections and inline-property/label predicates."""
 
         def declare_node(n: ast.NodePattern) -> str:
             name = n.var or self.fresh("node")
@@ -148,7 +162,7 @@ class _SingleQueryBuilder:
                 self._property_predicates(name, n.properties, predicates)
             return name
 
-        for part in clause.pattern.parts:
+        for part in pattern.parts:
             if part.path_var is not None:
                 raise IRBuildError("named paths are not supported yet")
             elems = part.elements
@@ -184,11 +198,39 @@ class _SingleQueryBuilder:
                 prev = nxt
                 i += 2
 
-        if clause.where is not None:
-            predicates.extend(self._split_ands(clause.where))
-        self.blocks.append(MatchBlock(
-            Pattern(tuple(entities), tuple(connections), tuple(bound)),
-            tuple(predicates), clause.optional))
+    # -- EXISTS subqueries ---------------------------------------------------
+
+    def _resolve_exists(self, expr: E.Expr) -> E.Expr:
+        """Rebind parser-stage ExistsSubQuery nodes (clause-AST pattern) to
+        IR-stage ones (ir Pattern + typed predicate tuple).  Resolution is
+        TOP-DOWN: a nested EXISTS must be built inside its enclosing
+        subquery's scope (after the enclosing pattern declared its vars),
+        which _build_exists does by recursing on the inner WHERE."""
+        if isinstance(expr, E.ExistsSubQuery):
+            if isinstance(expr.pattern, ast.Pattern):
+                return self._build_exists(expr)
+            return expr  # already IR-stage
+        return expr.map_children(
+            lambda c: self._resolve_exists(c) if isinstance(c, E.Expr) else c)
+
+    def _build_exists(self, sq: E.ExistsSubQuery) -> E.ExistsSubQuery:
+        saved_env = self.env
+        self.env = dict(saved_env)  # subquery scope: sees outer, adds local
+        try:
+            entities: List[IRField] = []
+            connections: List[Connection] = []
+            bound: List[str] = []
+            preds: List[E.Expr] = []
+            self._build_pattern(sq.pattern, entities, connections, bound,
+                                preds)
+            if sq.where is not None:
+                preds.extend(self._split_ands(
+                    self._resolve_exists(sq.where)))
+            pattern = Pattern(tuple(entities), tuple(connections),
+                              tuple(bound))
+            return E.ExistsSubQuery(pattern, None, tuple(preds))
+        finally:
+            self.env = saved_env
 
     def _property_predicates(self, var: str, props: E.Expr,
                              out: List[E.Expr]) -> None:
@@ -240,7 +282,7 @@ class _SingleQueryBuilder:
                 name = item.expr.name
             else:
                 name = item.expr.cypher_repr()
-            items.append((name, item.expr))
+            items.append((name, self._resolve_exists(item.expr)))
         visible = [name for name, _ in items]
         defining: Dict[str, E.Expr] = dict(items)
 
@@ -289,7 +331,8 @@ class _SingleQueryBuilder:
             hidden: List[str] = []
             order_rewritten: List[Tuple[E.Expr, bool]] = []
             for oi in body.order_by:
-                expr = self._resolve_order_expr(oi.expr, visible, defining)
+                expr = self._resolve_order_expr(
+                    self._resolve_exists(oi.expr), visible, defining)
                 # ORDER BY <expr> where <expr> is exactly a projected item's
                 # defining expression sorts by that item (openCypher rule).
                 for name, dexpr in items:
@@ -323,7 +366,8 @@ class _SingleQueryBuilder:
                             or body.limit is not None):
             order_rewritten = []
             for oi in body.order_by:
-                expr = self._resolve_order_expr(oi.expr, visible, defining)
+                expr = self._resolve_order_expr(
+                    self._resolve_exists(oi.expr), visible, defining)
                 for name, dexpr in items:
                     if expr == dexpr:  # ORDER BY a grouping-key expression
                         expr = E.Var(name)
@@ -337,7 +381,7 @@ class _SingleQueryBuilder:
                 tuple(order_rewritten), body.skip, body.limit))
 
         if where is not None:
-            self.blocks.append(FilterBlock(where))
+            self.blocks.append(FilterBlock(self._resolve_exists(where)))
         if is_return:
             self.blocks.append(ResultBlock(tuple(visible)))
 

@@ -242,6 +242,39 @@ class Disjoint(BinaryExpr):
 
 
 @dataclasses.dataclass(frozen=True)
+class ExistsSubQuery(Expr):
+    """``EXISTS { [MATCH] <pattern> [WHERE expr] }`` — true iff the pattern
+    has at least one match extending the current row (ref: okapi-logical
+    ExistsSubQuery — reconstructed, mount empty; SURVEY.md §2).
+
+    Two-stage payload: the parser stores the clause-AST pattern in
+    ``pattern`` with the raw WHERE in ``where``; IRBuilder replaces it
+    with a node holding the IR ``Pattern`` and the full typed predicate
+    tuple (inline property maps + WHERE) in ``predicates``.  The logical
+    planner lowers it to a row-id semi-join and never lets it reach a
+    backend."""
+    pattern: object
+    where: Optional["Expr"] = None
+    predicates: Tuple["Expr", ...] = ()
+
+    def outer_free_vars(self) -> Tuple[str, ...]:
+        """Outer-scope variable names this subquery depends on (IR-stage
+        only; parser-stage nodes are resolved before anyone needs this)."""
+        bound = getattr(self.pattern, "bound", ())
+        entities = getattr(self.pattern, "entities", ())
+        local = {f.name for f in entities}
+        names = list(bound)
+        for p in self.predicates:
+            for v in vars_in(p):
+                if v.name not in local and v.name not in names:
+                    names.append(v.name)
+        return tuple(names)
+
+    def cypher_repr(self) -> str:
+        return "EXISTS { ... }"
+
+
+@dataclasses.dataclass(frozen=True)
 class StartsWith(BinaryExpr):
     op = "STARTS WITH"
 
@@ -434,8 +467,24 @@ def is_aggregating(e: Expr) -> bool:
 
 
 def vars_in(e: Expr) -> Tuple[Var, ...]:
-    seen = []
-    for n in e.walk():
-        if isinstance(n, Var) and n not in seen:
-            seen.append(n)
+    """Free variables of ``e`` at its own scope level.  An EXISTS subquery
+    contributes the outer vars its pattern binds against plus any outer
+    vars in its predicates — but not its pattern-local variables."""
+    seen: list = []
+
+    def add(v: Var) -> None:
+        if v not in seen:
+            seen.append(v)
+
+    def go(n) -> None:
+        if isinstance(n, ExistsSubQuery):
+            for name in n.outer_free_vars():
+                add(Var(name))
+            return
+        if isinstance(n, Var):
+            add(n)
+        for c in n.children:
+            go(c)
+
+    go(e)
     return tuple(seen)

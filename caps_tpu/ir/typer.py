@@ -94,6 +94,8 @@ class SchemaTyper:
             return CTBoolean.nullable if nullable else CTBoolean
         if isinstance(e, (E.IsNull, E.IsNotNull)):
             return CTBoolean
+        if isinstance(e, E.ExistsSubQuery):
+            return CTBoolean  # EXISTS is never null
 
         if isinstance(e, (E.Equals, E.NotEquals, E.LessThan, E.LessThanOrEqual,
                           E.GreaterThan, E.GreaterThanOrEqual, E.In,

@@ -178,6 +178,18 @@ class Optional(LogicalOperator):
 
 
 @dataclasses.dataclass(frozen=True)
+class ExistsSemiJoin(LogicalOperator):
+    """EXISTS-subquery support (ref: okapi-logical ExistsSubQuery —
+    reconstructed; SURVEY.md §2): ``rhs`` extends ``lhs`` with the
+    subquery pattern and projects a constant ``marker``; the output keeps
+    every lhs row once, with ``marker`` non-null iff rhs matched it."""
+    lhs: LogicalOperator
+    rhs: LogicalOperator
+    marker: str
+    fields: Fields = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class CartesianProduct(LogicalOperator):
     lhs: LogicalOperator
     rhs: LogicalOperator

@@ -19,12 +19,44 @@ from caps_tpu.logical import ops as L
 from caps_tpu.okapi.types import CTNode
 
 
+_MISSING = object()
+
+
 class LogicalOptimizer:
+    def __init__(self):
+        # Optional/ExistsSemiJoin rhs trees embed the lhs chain as a shared
+        # structural prefix that relational planning matches by equality to
+        # thread the row-id tag.  While rewriting such an rhs, the embedded
+        # lhs is a *barrier*: it is swapped for the already-rewritten lhs
+        # and never descended into (and _push won't push predicates across
+        # it), so the prefix stays structurally identical on both sides.
+        self._barriers = {}
+
     def process(self, plan: L.LogicalPlan) -> L.LogicalPlan:
         root = self._rewrite(plan.root)
         return L.LogicalPlan(root, plan.result_fields, plan.returns_graph)
 
     def _rewrite(self, op: L.LogicalOperator) -> L.LogicalOperator:
+        rep = self._barriers.get(op, _MISSING)
+        if rep is not _MISSING:
+            return rep
+        if isinstance(op, (L.Optional, L.ExistsSemiJoin)):
+            new_lhs = self._rewrite(op.lhs)
+            # Register the rewritten lhs too: once substituted into the rhs
+            # it is what _push/_rewrite actually encounter there.
+            saved = [(k, self._barriers.get(k, _MISSING))
+                     for k in (op.lhs, new_lhs)]
+            self._barriers[op.lhs] = new_lhs
+            self._barriers[new_lhs] = new_lhs
+            try:
+                new_rhs = self._rewrite(op.rhs)
+            finally:
+                for k, prev in saved:
+                    if prev is _MISSING:
+                        self._barriers.pop(k, None)
+                    else:
+                        self._barriers[k] = prev
+            return dataclasses.replace(op, lhs=new_lhs, rhs=new_rhs)
         op = op.map_children(
             lambda c: self._rewrite(c) if isinstance(c, L.LogicalOperator) else c)
         if isinstance(op, L.Filter):
@@ -63,6 +95,8 @@ class LogicalOptimizer:
               ) -> Opt[L.LogicalOperator]:
         """Try to push ``pred`` below ``op``; returns the rewritten operator
         or None if the predicate must stay above."""
+        if op in self._barriers:
+            return None  # never rewrite across an Optional/Exists lhs prefix
         needed = {v.name for v in E.vars_in(pred)}
 
         # Label predicate meeting its producing scan/expand: absorb it.

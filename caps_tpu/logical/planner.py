@@ -20,8 +20,8 @@ from caps_tpu.logical import ops as L
 from caps_tpu.okapi.graph import QualifiedGraphName
 from caps_tpu.okapi.schema import Schema
 from caps_tpu.okapi.types import (
-    CTAny, CTList, CTNode, CTRelationship, CypherType, _CTList, _CTNode,
-    _CTRelationship,
+    CTAny, CTBoolean, CTList, CTNode, CTRelationship, CypherType, _CTList,
+    _CTNode, _CTRelationship,
 )
 
 
@@ -71,6 +71,32 @@ class LogicalPlanner:
         return L.LogicalPlan(op, result_fields, returns_graph)
 
 
+def _top_exists(expr: E.Expr) -> List[E.ExistsSubQuery]:
+    """Top-level ExistsSubQuery nodes of ``expr`` — does NOT descend into a
+    subquery's own predicates (those lower inside its rhs)."""
+    out: List[E.ExistsSubQuery] = []
+
+    def go(n):
+        if isinstance(n, E.ExistsSubQuery):
+            out.append(n)
+            return
+        for c in n.children:
+            go(c)
+
+    go(expr)
+    return out
+
+
+def _replace_exists(expr: E.Expr, mapping: Mapping[E.Expr, E.Expr]) -> E.Expr:
+    """Replace top-level ExistsSubQuery nodes wholesale (no descent into a
+    replaced node, so a structurally-equal nested subquery inside another
+    subquery's predicates is left alone)."""
+    if isinstance(expr, E.ExistsSubQuery):
+        return mapping[expr]
+    return expr.map_children(
+        lambda c: _replace_exists(c, mapping) if isinstance(c, E.Expr) else c)
+
+
 def _rel_types_of(ct: CypherType) -> frozenset:
     """Declared rel types of a rel var (CTRelationship) or var-length rel
     var (CTList(CTRelationship))."""
@@ -86,6 +112,7 @@ class _QueryPlanner:
         self.schema = parent.ambient_schema
         self.typer = SchemaTyper(self.schema, parent.parameters)
         self.current_graph: Opt[QualifiedGraphName] = None
+        self._marker_count = 0
 
     # -- helpers ------------------------------------------------------------
 
@@ -102,11 +129,23 @@ class _QueryPlanner:
         if isinstance(block, B.AggregationBlock):
             return self._plan_aggregation(op, block)
         if isinstance(block, B.FilterBlock):
-            return L.Filter(op, block.predicate, fields=op.fields)
+            names = op.field_names
+            out, pred = self._rewrite_exists(op, block.predicate)
+            out = L.Filter(out, pred, fields=out.fields)
+            if out.field_names != names:
+                out = self._select(out, names)  # drop EXISTS markers
+            return out
         if isinstance(block, B.OrderAndSliceBlock):
             out = op
             if block.order:
-                out = L.OrderBy(out, block.order, fields=out.fields)
+                names = out.field_names
+                items = []
+                for expr, asc in block.order:
+                    out, expr = self._rewrite_exists(out, expr)
+                    items.append((expr, asc))
+                out = L.OrderBy(out, tuple(items), fields=out.fields)
+                if out.field_names != names:
+                    out = self._select(out, names)  # drop EXISTS markers
             if block.skip is not None:
                 out = L.Skip(out, block.skip, fields=out.fields)
             if block.limit is not None:
@@ -150,12 +189,13 @@ class _QueryPlanner:
 
     def _plan_project(self, op: L.LogicalOperator, block: B.ProjectBlock
                       ) -> L.LogicalOperator:
-        env = op.env
         new_items = []
         for name, expr in block.items:
             if isinstance(expr, E.Var) and expr.name == name:
                 continue  # passthrough
+            op, expr = self._rewrite_exists(op, expr)
             new_items.append((name, expr))
+        env = op.env
         out = op
         if new_items:
             added = tuple((n, self.type_of(x, env)) for n, x in new_items)
@@ -169,10 +209,18 @@ class _QueryPlanner:
 
     def _plan_aggregation(self, op: L.LogicalOperator, block: B.AggregationBlock
                           ) -> L.LogicalOperator:
+        group = []
+        for n, x in block.group:
+            op, x = self._rewrite_exists(op, x)
+            group.append((n, x))
+        aggs = []
+        for n, a in block.aggregations:
+            op, a = self._rewrite_exists(op, a)
+            aggs.append((n, a))
         env = op.env
-        fields = tuple((n, self.type_of(x, env)) for n, x in block.group) + \
-            tuple((n, self.type_of(a, env)) for n, a in block.aggregations)
-        return L.Aggregate(op, block.group, block.aggregations, fields=fields)
+        fields = tuple((n, self.type_of(x, env)) for n, x in group) + \
+            tuple((n, self.type_of(a, env)) for n, a in aggs)
+        return L.Aggregate(op, tuple(group), tuple(aggs), fields=fields)
 
     # -- MATCH pattern solving ---------------------------------------------
 
@@ -180,14 +228,51 @@ class _QueryPlanner:
                     ) -> L.LogicalOperator:
         lhs = op
         rhs = self._plan_pattern(op, block.pattern)
+        base_names = rhs.field_names
         for pred in block.predicates:
+            rhs, pred = self._rewrite_exists(rhs, pred)
             rhs = L.Filter(rhs, pred, fields=rhs.fields)
         if block.optional:
             if not lhs.fields:
                 raise LogicalPlanningError(
                     "OPTIONAL MATCH requires a preceding binding clause")
-            return L.Optional(lhs, rhs, fields=rhs.fields)
-        return rhs
+            out = L.Optional(lhs, rhs, fields=rhs.fields)
+        else:
+            out = rhs
+        if out.field_names != base_names:
+            # EXISTS markers linger inside the (possibly Optional) branch —
+            # a Select inside an Optional rhs would break its row-id wiring,
+            # so they are dropped here, outside it.
+            out = self._select(out, base_names)
+        return out
+
+    # -- EXISTS subqueries ---------------------------------------------------
+
+    def _rewrite_exists(self, op: L.LogicalOperator, expr: E.Expr
+                        ) -> Tuple[L.LogicalOperator, E.Expr]:
+        """Lower every top-level ExistsSubQuery in ``expr`` to a row-id
+        semi-join (L.ExistsSemiJoin) producing a nullable marker field, and
+        substitute ``IS NOT NULL(marker)`` for the subquery node."""
+        subqueries = _top_exists(expr)
+        if not subqueries:
+            return op, expr
+        mapping: Dict[E.Expr, E.Expr] = {}
+        for sq in subqueries:
+            if sq in mapping:
+                continue
+            marker = f"__exists_{self._marker_count}"
+            self._marker_count += 1
+            rhs = self._plan_pattern(op, sq.pattern)
+            for p in sq.predicates:
+                rhs, p = self._rewrite_exists(rhs, p)  # nested EXISTS
+                rhs = L.Filter(rhs, p, fields=rhs.fields)
+            rhs = L.Project(rhs, ((marker, E.Lit(True)),),
+                            fields=rhs.fields + ((marker, CTBoolean),))
+            op = L.ExistsSemiJoin(
+                op, rhs, marker,
+                fields=op.fields + ((marker, CTBoolean.nullable),))
+            mapping[sq] = E.IsNotNull(E.Var(marker))
+        return op, _replace_exists(expr, mapping)
 
     def _plan_pattern(self, op: L.LogicalOperator, pattern: Pattern
                       ) -> L.LogicalOperator:

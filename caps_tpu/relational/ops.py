@@ -326,6 +326,37 @@ class UnionAllOp(RelationalOperator):
         return target, align(lh, lt).union_all(align(rh, rt))
 
 
+class ExistsJoinOp(RelationalOperator):
+    """Row-id semi-join implementing EXISTS subqueries: lhs (tagged with a
+    row index) keeps every row exactly once; the nullable boolean
+    ``marker`` var is true where the subquery side produced at least one
+    row for that row id, null otherwise (ref: okapi-relational planning of
+    ExistsSubQuery — reconstructed; SURVEY.md §2)."""
+
+    def __init__(self, context, lhs_tagged: RelationalOperator,
+                 rhs: RelationalOperator, rid_col: str, marker: str):
+        super().__init__(context, [lhs_tagged, rhs])
+        self.rid_col = rid_col
+        self.marker = marker
+
+    def _compute(self):
+        lh, lt = self.children[0].result
+        rh, rt = self.children[1].result
+        mcol = rh.column(E.Var(self.marker))
+        rid_right = f"__ex_{self.rid_col}"
+        rsel = rt.select([self.rid_col, mcol]).distinct() \
+            .rename({self.rid_col: rid_right})
+        joined = lt.join(rsel, "left", [(self.rid_col, rid_right)])
+        out_entries = [(e, lh.column(e), lh.type_of(e)) for e in lh.exprs
+                       if e != E.Var(self.rid_col)] \
+            + [(E.Var(self.marker), mcol, CTBoolean.nullable)]
+        out_header = RecordHeader(out_entries)
+        return out_header, joined.select(list(out_header.columns))
+
+    def _pretty_args(self):
+        return self.marker
+
+
 class DistinctOp(RelationalOperator):
     def __init__(self, context, parent):
         super().__init__(context, [parent])

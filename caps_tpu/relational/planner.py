@@ -104,6 +104,9 @@ class RelationalPlanner:
         if isinstance(op, L.Optional):
             tagged, rhs, rid = self._plan_optional(op.lhs, op.rhs)
             return R.OptionalJoinOp(ctx, tagged, rhs, rid)
+        if isinstance(op, L.ExistsSemiJoin):
+            tagged, rhs, rid = self._plan_optional(op.lhs, op.rhs)
+            return R.ExistsJoinOp(ctx, tagged, rhs, rid, op.marker)
         if isinstance(op, L.CartesianProduct):
             l, r = self._plan_two(op.lhs, op.rhs)
             return R.CrossOp(ctx, l, r)
