@@ -53,6 +53,12 @@ class DeviceBackend:
         self.config = config
         self.fallbacks = 0
         self.fallback_reasons: List[str] = []
+        # Size-sync routing for the fused executor (backends/tpu/fused.py):
+        # None = eager (device->host sync per data-dependent size);
+        # ("record", sizes)       = eager + record every size in order;
+        # ("replay", sizes, [i])  = serve sizes from the memo, NO syncs —
+        # the whole query stays async / traceable.
+        self.count_mode: Optional[tuple] = None
         self.mesh = None
         self.axis = config.mesh_axis
         if config.mesh_shape:
@@ -84,6 +90,28 @@ class DeviceBackend:
 
     def bucket(self, n: int) -> int:
         return max(1, self.config.bucket_for(n))
+
+    def consume_count(self, dev_scalar) -> int:
+        """Materialize a data-dependent size (see ``count_mode``)."""
+        mode = self.count_mode
+        if mode is None:
+            return int(dev_scalar)
+        if mode[0] == "record":
+            v = int(dev_scalar)
+            mode[1].append(v)
+            return v
+        sizes, cursor = mode[1], mode[2]
+        if cursor[0] >= len(sizes):
+            raise FusedReplayMismatch(
+                f"replay consumed {cursor[0]} sizes but the recording only "
+                f"has {len(sizes)}")
+        v = sizes[cursor[0]]
+        cursor[0] += 1
+        return v
+
+
+class FusedReplayMismatch(RuntimeError):
+    """The op sequence during fused replay diverged from the recording."""
 
 
 class DeviceTable(Table):
@@ -240,7 +268,7 @@ class DeviceTable(Table):
         return self._compact(mask)
 
     def _compact(self, mask: jnp.ndarray) -> "DeviceTable":
-        new_n = int(K.mask_count(mask))
+        new_n = self.backend.consume_count(K.mask_count(mask))
         out_cap = self.backend.bucket(new_n)
         idx, _ = K.compact_indices(mask, out_cap)
         idx = self.backend.place_rows(idx)
@@ -290,7 +318,7 @@ class DeviceTable(Table):
         rk_sorted, perm = self._cached_right_sort(other, rcol)
         counts, lo = K.probe_count(self._join_key(lcol), l_ok, rk_sorted)
         left_join = how == "left"
-        total = int(K.join_total(counts, l_ok, left_join))
+        total = self.backend.consume_count(K.join_total(counts, l_ok, left_join))
         out_cap = self.backend.bucket(total)
         l_idx, r_idx, out_valid, r_matched, _ = K.join_expand(
             counts, lo, perm, l_ok, out_cap, left_join)
@@ -439,7 +467,7 @@ class DeviceTable(Table):
             stacked = jnp.stack([k[perm].astype(jnp.float64) for k in keys[1:]])
             change = K.neighbor_change(stacked) & K.row_mask(cap, self._n)
             seg_id = jnp.clip(jnp.cumsum(change.astype(jnp.int32)) - 1, 0, None)
-            n_groups = int(K.mask_count(change))
+            n_groups = self.backend.consume_count(K.mask_count(change))
         else:
             sorted_cols = dict(self._cols)
             seg_id = jnp.zeros(cap, jnp.int32)
@@ -497,8 +525,8 @@ class DeviceTable(Table):
             col = self._cols[c]
             if col.kind == "int":
                 ok = col.valid & row_ok
-                lo = int(jnp.min(jnp.where(ok, col.data, 0)))
-                hi = int(jnp.max(jnp.where(ok, col.data, 0)))
+                lo = self.backend.consume_count(jnp.min(jnp.where(ok, col.data, 0)))
+                hi = self.backend.consume_count(jnp.max(jnp.where(ok, col.data, 0)))
                 if not (-2**31 < lo and hi < 2**31):
                     return None
 
@@ -633,7 +661,7 @@ class DeviceTable(Table):
             return self._fallback("explode of non-list column").explode(
                 list_col, out_col, out_type)
         ok = col.valid & self.row_ok
-        total = int(jnp.where(ok, col.lens, 0).sum())
+        total = self.backend.consume_count(jnp.where(ok, col.lens, 0).sum())
         out_cap = self.backend.bucket(total)
         row, within, out_valid, _ = K.explode_expand(col.lens, ok, out_cap)
         rest = {c: v for c, v in self._cols.items() if c != list_col}

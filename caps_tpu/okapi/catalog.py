@@ -55,6 +55,8 @@ class CypherCatalog(PropertyGraphCatalog):
         self._sources: Dict[Namespace, PropertyGraphDataSource] = {
             Namespace(): SessionGraphDataSource()
         }
+        # bumped on every mutation; part of the fused executor's plan key
+        self.version = 0
 
     @property
     def session_namespace(self) -> Namespace:
@@ -66,6 +68,7 @@ class CypherCatalog(PropertyGraphCatalog):
         if namespace in self._sources:
             raise ValueError(f"namespace {namespace!r} already registered")
         self._sources[namespace] = source
+        self.version += 1
 
     def deregister_source(self, namespace: Namespace) -> None:
         if isinstance(namespace, str):
@@ -99,10 +102,12 @@ class CypherCatalog(PropertyGraphCatalog):
     def store(self, name: NameLike, graph: PropertyGraph) -> None:
         qgn = _qualify(name)
         self.source(qgn.namespace).store(qgn.graph_name, graph)
+        self.version += 1
 
     def delete(self, name: NameLike) -> None:
         qgn = _qualify(name)
         self.source(qgn.namespace).delete(qgn.graph_name)
+        self.version += 1
 
     def graph_names(self) -> Tuple[QualifiedGraphName, ...]:
         out = []

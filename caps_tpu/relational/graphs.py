@@ -7,6 +7,7 @@ okapi-relational/.../impl/graph/ — reconstructed, mount empty; SURVEY.md
 """
 from __future__ import annotations
 
+import itertools
 from typing import Any, FrozenSet, Iterable, List, Mapping, Optional, Tuple
 
 from caps_tpu.ir import exprs as E
@@ -111,9 +112,13 @@ def _align_rel_scan(rt: RelationshipTable, header: RecordHeader, var: str) -> Ta
 class ScanGraph(RelationalCypherGraph):
     """A graph stored as one table per label-combination / relationship type."""
 
+    _version_counter = itertools.count(1)
+
     def __init__(self, session, node_tables: Iterable[NodeTable] = (),
                  rel_tables: Iterable[RelationshipTable] = ()):
         super().__init__(session)
+        # Monotone graph identity for plan/size-memo caches (fused executor)
+        self.version = next(ScanGraph._version_counter)
         self.node_tables: Tuple[NodeTable, ...] = tuple(node_tables)
         self.rel_tables: Tuple[RelationshipTable, ...] = tuple(rel_tables)
         schema = Schema.empty()
