@@ -18,10 +18,24 @@ class TPUCypherSession(RelationalCypherSession):
         super().__init__(config)
         self.backend = DeviceBackend(self.config)
         self._factory = DeviceTableFactory(self.backend)
+        from caps_tpu.backends.tpu.fused import FusedExecutor
+        self.fused = FusedExecutor(self.backend,
+                                   max_entries=self.config.compile_cache_size)
 
     @property
     def table_factory(self) -> DeviceTableFactory:
         return self._factory
+
+    def _cypher_on_graph(self, graph, query, parameters=None):
+        """Route every query through the fused executor: first run records
+        the data-dependent sizes, repeats replay them with zero host syncs
+        (backends/tpu/fused.py — the whole-stage-codegen analog)."""
+        if not self.config.use_fused:
+            return super()._cypher_on_graph(graph, query, parameters)
+        key = self.fused.key(graph, query, dict(parameters or {}))
+        return self.fused.run(
+            key, lambda: super(TPUCypherSession, self)._cypher_on_graph(
+                graph, query, parameters))
 
     @property
     def fallback_count(self) -> int:

@@ -53,6 +53,7 @@ class DeviceBackend:
         self.config = config
         self.fallbacks = 0
         self.fallback_reasons: List[str] = []
+        self.syncs = 0  # device->host scalar materializations (perf metric)
         # Size-sync routing for the fused executor (backends/tpu/fused.py):
         # None = eager (device->host sync per data-dependent size);
         # ("record", sizes)       = eager + record every size in order;
@@ -95,8 +96,10 @@ class DeviceBackend:
         """Materialize a data-dependent size (see ``count_mode``)."""
         mode = self.count_mode
         if mode is None:
+            self.syncs += 1
             return int(dev_scalar)
         if mode[0] == "record":
+            self.syncs += 1
             v = int(dev_scalar)
             mode[1].append(v)
             return v

@@ -47,6 +47,10 @@ class EngineConfig:
     # Kernel switches (pallas kernels fall back to jnp when off)
     use_pallas: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_PALLAS", True))
+    # Fused executor (backends/tpu/fused.py): record data-dependent sizes
+    # on a query's first run, replay them sync-free on repeats.
+    use_fused: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_USE_FUSED", True))
     # Compile-cache capacity (query programs keyed by plan+bucket shapes)
     compile_cache_size: int = dataclasses.field(
         default_factory=lambda: _env_int("CAPS_TPU_COMPILE_CACHE", 512))

@@ -1,0 +1,114 @@
+"""Fused executor (backends/tpu/fused.py): record sizes on a query's
+first run, replay them sync-free thereafter, recover from divergence.
+
+The reference's analog is Spark's whole-stage codegen pipeline under
+SparkTable (ref: spark-cypher/.../impl/table/SparkTable.scala —
+reconstructed, mount empty; SURVEY.md §3.1)."""
+from __future__ import annotations
+
+import pytest
+
+from caps_tpu.backends.tpu.session import TPUCypherSession
+from caps_tpu.backends.tpu.table import FusedReplayMismatch
+from caps_tpu.okapi.config import EngineConfig
+from tests.util import make_graph
+
+
+QUERY = ("MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) "
+         "WHERE a.name = 'Alice' RETURN c.name AS n")
+
+
+def _social(session):
+    return make_graph(
+        session,
+        {("Person",): [
+            {"_id": 1, "name": "Alice", "age": 30},
+            {"_id": 2, "name": "Bob", "age": 40},
+            {"_id": 3, "name": "Eve", "age": 50},
+            {"_id": 4, "name": "Mallory", "age": 60},
+        ]},
+        {"KNOWS": [(1, 2, {}), (2, 3, {}), (2, 4, {}), (3, 1, {})]},
+    )
+
+
+def test_replay_is_sync_free_and_correct():
+    session = TPUCypherSession()
+    g = _social(session)
+    first = g.cypher(QUERY).records.to_maps()
+    assert session.fused.recordings == 1 and session.fused.replays == 0
+    syncs_after_record = session.backend.syncs
+    assert syncs_after_record > 0  # record mode syncs like eager mode
+
+    second = g.cypher(QUERY).records.to_maps()
+    assert second == first
+    assert session.fused.replays == 1
+    # the replay run did ZERO count syncs — the memo served every size
+    assert session.backend.syncs == syncs_after_record
+
+
+def test_distinct_params_get_distinct_recordings():
+    session = TPUCypherSession()
+    g = _social(session)
+    q = ("MATCH (a:Person)-[:KNOWS]->(b) WHERE a.name = $seed "
+         "RETURN count(*) AS c")
+    c_alice = g.cypher(q, {"seed": "Alice"}).records.to_maps()[0]["c"]
+    c_bob = g.cypher(q, {"seed": "Bob"}).records.to_maps()[0]["c"]
+    assert (c_alice, c_bob) == (1, 2)
+    assert session.fused.recordings == 2
+    # replays with the matching key serve the right sizes
+    assert g.cypher(q, {"seed": "Bob"}).records.to_maps()[0]["c"] == 2
+    assert g.cypher(q, {"seed": "Alice"}).records.to_maps()[0]["c"] == 1
+    assert session.fused.replays == 2
+
+
+def test_mismatch_recovery_rerecords():
+    session = TPUCypherSession()
+    g = _social(session)
+    first = g.cypher(QUERY).records.to_maps()
+    # poison the memo: truncate the recording so replay exhausts it
+    (key, (plen, sizes)), = session.fused._memo.items()
+    assert sizes, "expected at least one recorded size"
+    session.fused._memo[key] = (plen, sizes[:1])
+    again = g.cypher(QUERY).records.to_maps()
+    assert again == first
+    assert session.fused.mismatches == 1
+    # the memo was re-recorded and replays work again
+    assert g.cypher(QUERY).records.to_maps() == first
+    assert session.fused.replays >= 1
+
+
+def test_mismatch_surplus_sizes_detected():
+    session = TPUCypherSession()
+    g = _social(session)
+    first = g.cypher(QUERY).records.to_maps()
+    (key, (plen, sizes)), = session.fused._memo.items()
+    # surplus sizes: replay finishes with leftovers -> audit trips
+    session.fused._memo[key] = (plen, list(sizes) + [7])
+    assert g.cypher(QUERY).records.to_maps() == first
+    assert session.fused.mismatches == 1
+
+
+def test_determinism_check_rides_replay():
+    session = TPUCypherSession(config=EngineConfig(determinism_check=True))
+    g = _social(session)
+    res = g.cypher(QUERY)
+    assert "determinism_digest" in res.metrics
+    assert res.records.to_maps() == [{"n": "Eve"}, {"n": "Mallory"}]
+    # the replay leg of the determinism check reused the recording
+    assert session.fused.replays >= 1
+
+
+def test_fused_disabled_by_config():
+    session = TPUCypherSession(config=EngineConfig(use_fused=False))
+    g = _social(session)
+    assert g.cypher(QUERY).records.to_maps() == [{"n": "Eve"},
+                                                 {"n": "Mallory"}]
+    assert session.fused.recordings == 0 and session.fused.replays == 0
+
+
+def test_sharded_replay_parity():
+    session = TPUCypherSession(config=EngineConfig(mesh_shape=(8,)))
+    g = _social(session)
+    first = g.cypher(QUERY).records.to_maps()
+    assert g.cypher(QUERY).records.to_maps() == first
+    assert session.fused.replays == 1
