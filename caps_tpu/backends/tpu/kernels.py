@@ -127,6 +127,18 @@ def neighbor_change(sorted_keys_stacked: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([jnp.ones((1,), bool), diff])
 
 
+@jax.jit
+def neighbor_change_keys(sorted_keys) -> jnp.ndarray:
+    """neighbor_change over a *list* of sorted key arrays compared each in
+    its own dtype — int64 keys are never squeezed through float64 (which
+    collides keys >= 2^53)."""
+    cap = sorted_keys[0].shape[0]
+    diff = jnp.zeros((max(cap - 1, 0),), bool)
+    for k in sorted_keys:
+        diff = diff | (k[1:] != k[:-1])
+    return jnp.concatenate([jnp.ones((1,), bool), diff])
+
+
 # -- segmented aggregation --------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("num_segments", "kind"))

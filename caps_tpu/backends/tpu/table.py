@@ -313,18 +313,40 @@ class DeviceTable(Table):
         rcol._join_sort = (key, res)
         return res
 
+    def _csr_for(self, other: "DeviceTable", rcol: Column):
+        """The HBM-resident CSR for a build-side column, if the ingest
+        hook (DeviceTableFactory.prepare_rel_table) attached one and the
+        table still has the shape it was built for."""
+        if not self.backend.config.use_csr:
+            return None
+        cached = getattr(rcol, "_csr", None)
+        if cached is not None and cached[0] == (other._n,):
+            return cached[1]
+        return None
+
     def _sort_merge_join(self, other: "DeviceTable", how: str,
                          pairs: Sequence[Tuple[str, str]]) -> "DeviceTable":
         lc, rc = pairs[0]
         lcol, rcol = self._cols[lc], other._cols[rc]
         l_ok = lcol.valid & self.row_ok
-        rk_sorted, perm = self._cached_right_sort(other, rcol)
-        counts, lo = K.probe_count(self._join_key(lcol), l_ok, rk_sorted)
         left_join = how == "left"
+        csr = self._csr_for(other, rcol)
+        if csr is not None:
+            # CSR probe: two indptr gathers per row, no sort, no search
+            counts, lo = csr.probe(self._join_key(lcol), l_ok)
+            perm = csr.perm
+        else:
+            rk_sorted, perm = self._cached_right_sort(other, rcol)
+            counts, lo = K.probe_count(self._join_key(lcol), l_ok, rk_sorted)
         total = self.backend.consume_count(K.join_total(counts, l_ok, left_join))
         out_cap = self.backend.bucket(total)
-        l_idx, r_idx, out_valid, r_matched, _ = K.join_expand(
-            counts, lo, perm, l_ok, out_cap, left_join)
+        if self.backend.config.use_pallas:
+            l_idx, r_idx, out_valid, r_matched = OPS.join_expand_via_positions(
+                counts, lo, perm, l_ok, out_cap, left_join,
+                interpret=OPS.default_interpret())
+        else:
+            l_idx, r_idx, out_valid, r_matched, _ = K.join_expand(
+                counts, lo, perm, l_ok, out_cap, left_join)
         l_idx = self.backend.place_rows(l_idx)
         r_idx = self.backend.place_rows(r_idx)
         out_cols = _gather_cols(self._cols, l_idx)
@@ -400,8 +422,7 @@ class DeviceTable(Table):
         except UnsupportedOnDevice as ex:
             return self._fallback(str(ex)).distinct()
         sorted_cols = _gather_cols(self._cols, perm)
-        stacked = jnp.stack([k[perm].astype(jnp.float64) for k in keys])
-        change = K.neighbor_change(stacked)
+        change = K.neighbor_change_keys([k[perm] for k in keys])
         keep = change & K.row_mask(self.capacity, self._n)
         tmp = DeviceTable(self.backend, sorted_cols, self._n)
         return tmp._compact(keep)
@@ -467,8 +488,8 @@ class DeviceTable(Table):
                 keys.extend(_sort_keys(self._cols[c], True, True, pool))
             perm = K.sort_perm(keys, cap)
             sorted_cols = _gather_cols(self._cols, perm)
-            stacked = jnp.stack([k[perm].astype(jnp.float64) for k in keys[1:]])
-            change = K.neighbor_change(stacked) & K.row_mask(cap, self._n)
+            change = K.neighbor_change_keys(
+                [k[perm] for k in keys[1:]]) & K.row_mask(cap, self._n)
             seg_id = jnp.clip(jnp.cumsum(change.astype(jnp.int32)) - 1, 0, None)
             n_groups = self.backend.consume_count(K.mask_count(change))
         else:
@@ -790,6 +811,27 @@ class DeviceTableFactory(TableFactory):
     def __init__(self, backend: DeviceBackend):
         self.backend = backend
         self._local = LocalTableFactory()
+
+    def prepare_rel_table(self, rel_table) -> None:
+        """Ingest-time physical layout: build HBM-resident CSR adjacency
+        over the relationship table's source and target columns (C++
+        csr_build on the host when available, one numpy sort otherwise).
+        Every later Expand hop against this table probes ``indptr``
+        instead of sorting + binary-searching the edge list."""
+        if not self.backend.config.use_csr:
+            return
+        t = rel_table.table
+        if not isinstance(t, DeviceTable) or t.is_local:
+            return
+        m = rel_table.mapping
+        for name in (m.source_col, m.target_col):
+            col = t._cols.get(name)
+            if col is None or col.kind not in ("id", "int"):
+                continue
+            if getattr(col, "_csr", None) is not None:
+                continue
+            csr = OPS.build_csr(col.data, col.valid & t.row_ok, t._n)
+            col._csr = ((t._n,), csr)
 
     def from_columns(self, data: Mapping[str, Sequence[Any]],
                      types: Mapping[str, CypherType]) -> DeviceTable:

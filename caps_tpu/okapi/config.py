@@ -47,6 +47,11 @@ class EngineConfig:
     # Kernel switches (pallas kernels fall back to jnp when off)
     use_pallas: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_PALLAS", True))
+    # HBM-resident CSR adjacency as the relationship scan's physical
+    # layout (ops/expand.py DeviceCSR); joins against it probe indptr
+    # instead of sorting + binary-searching the edge table.
+    use_csr: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_USE_CSR", True))
     # Fused executor (backends/tpu/fused.py): record data-dependent sizes
     # on a query's first run, replay them sync-free on repeats.
     use_fused: bool = dataclasses.field(

@@ -15,10 +15,22 @@ from caps_tpu.ops.segment import (
     dense_segment_agg_sharded,
     default_interpret,
 )
+from caps_tpu.ops.expand import (
+    DeviceCSR,
+    build_csr,
+    expand_positions,
+    expand_positions_ref,
+    join_expand_via_positions,
+)
 
 __all__ = [
     "dense_segment_agg",
     "dense_segment_agg_ref",
     "dense_segment_agg_sharded",
     "default_interpret",
+    "DeviceCSR",
+    "build_csr",
+    "expand_positions",
+    "expand_positions_ref",
+    "join_expand_via_positions",
 ]

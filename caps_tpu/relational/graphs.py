@@ -121,6 +121,9 @@ class ScanGraph(RelationalCypherGraph):
         self.version = next(ScanGraph._version_counter)
         self.node_tables: Tuple[NodeTable, ...] = tuple(node_tables)
         self.rel_tables: Tuple[RelationshipTable, ...] = tuple(rel_tables)
+        for rt in self.rel_tables:
+            # ingest-time physical layout (CSR adjacency on device backends)
+            self.factory.prepare_rel_table(rt)
         schema = Schema.empty()
         for nt in self.node_tables:
             schema = schema.union(nt.schema())

@@ -172,3 +172,9 @@ class TableFactory(abc.ABC):
     def empty(self, cols: Sequence[str],
               types: Mapping[str, CypherType]) -> Table:
         ...
+
+    def prepare_rel_table(self, rel_table) -> None:
+        """Backend hook called once per relationship table at graph
+        creation: device backends build their physical adjacency layout
+        (HBM-resident CSR over the source/target columns) here so every
+        later Expand hop probes it.  Default: no-op."""
