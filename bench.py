@@ -98,6 +98,9 @@ def _probe_device(timeout_s: float | None = None) -> bool:
 
 def _force_cpu():
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Pallas kernels only *compile* on TPU; on CPU they run in the (slow)
+    # interpreter, so the honest CPU-fallback number uses the jnp twins.
+    os.environ.setdefault("CAPS_TPU_USE_PALLAS", "0")
     import jax
     jax.config.update("jax_platforms", "cpu")
     try:

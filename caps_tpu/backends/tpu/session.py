@@ -14,6 +14,11 @@ from caps_tpu.relational.session import RelationalCypherSession
 
 
 class TPUCypherSession(RelationalCypherSession):
+    # planner gate for the SpMV count pushdown (relational/count_pattern.py);
+    # the local oracle stays on the join path so parity tests remain
+    # independent
+    supports_count_pushdown = True
+
     def __init__(self, config=None):
         super().__init__(config)
         self.backend = DeviceBackend(self.config)

@@ -52,6 +52,14 @@ class EngineConfig:
     # instead of sorting + binary-searching the edge table.
     use_csr: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_CSR", True))
+    # Aggregate pushdown (relational/count_pattern.py): lower count-only
+    # pattern chains to SpMV over the adjacency instead of join+count.
+    use_count_pushdown: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_COUNT_PUSHDOWN", True))
+    # On a mesh, uniform pushdown chains use the ppermute ring schedule
+    # (parallel/ring.py) instead of XLA-inserted all-reduces.
+    use_ring: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_USE_RING", True))
     # Fused executor (backends/tpu/fused.py): record data-dependent sizes
     # on a query's first run, replay them sync-free on repeats.
     use_fused: bool = dataclasses.field(

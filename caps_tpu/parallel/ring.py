@@ -57,10 +57,12 @@ def _ring_hop(cnt_block, edge_src, edge_dst, edge_ok, *, axis: str,
 
 
 def make_ring_khop(mesh: Mesh, n_nodes: int, n_hops: int,
-                   axis: str = "shard"):
+                   axis: str = "shard", masked: bool = False):
     """Build the jitted k-hop ring expansion: seed counts and edges come
     in sharded (node blocks / edge shards), result is the total path count
-    and the final block-sharded frontier."""
+    and the final block-sharded frontier.  With ``masked``, a node-block-
+    sharded mask vector is multiplied into the frontier after every hop
+    (the planner's per-hop node-existence/label mask)."""
     n_shards = int(mesh.devices.size)
     if n_nodes % n_shards:
         raise ValueError(f"n_nodes {n_nodes} must divide over {n_shards}")
@@ -76,27 +78,46 @@ def make_ring_khop(mesh: Mesh, n_nodes: int, n_hops: int,
                     f"{n_shards} shards; pad edges (edge_ok=False) to a "
                     f"multiple of the shard count")
 
-    def body(seed_block, edge_src, edge_dst, edge_ok):
-        blk = seed_block
-        for _ in range(n_hops):
-            blk = hop(blk, edge_src, edge_dst, edge_ok)
-        total = jax.lax.psum(blk.sum(), axis)
-        return total, blk
+    if masked:
+        def body(seed_block, edge_src, edge_dst, edge_ok, mask_block):
+            blk = seed_block
+            for _ in range(n_hops):
+                blk = hop(blk, edge_src, edge_dst, edge_ok) * mask_block
+            total = jax.lax.psum(blk.sum(), axis)
+            return total, blk
+        in_specs = (P(axis),) * 5
+    else:
+        def body(seed_block, edge_src, edge_dst, edge_ok):
+            blk = seed_block
+            for _ in range(n_hops):
+                blk = hop(blk, edge_src, edge_dst, edge_ok)
+            total = jax.lax.psum(blk.sum(), axis)
+            return total, blk
+        in_specs = (P(axis),) * 4
 
-    mapped = shard_map(
-        body, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(), P(axis)))
+    mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=(P(), P(axis)))
     jitted = jax.jit(mapped)
 
-    def call(seed_block, edge_src, edge_dst, edge_ok):
+    def call(seed_block, edge_src, edge_dst, edge_ok, mask_block=None):
         check_edges(edge_src, edge_dst, edge_ok)
         if seed_block.shape[0] != n_nodes:
             raise ValueError(f"seed length {seed_block.shape[0]} != n_nodes "
                              f"{n_nodes}")
-        return jitted(seed_block, edge_src, edge_dst, edge_ok)
+        if masked != (mask_block is not None):
+            raise ValueError("mask_block must be passed iff masked=True")
+        args = (seed_block, edge_src, edge_dst, edge_ok)
+        return jitted(*args, mask_block) if masked else jitted(*args)
 
     return call
+
+
+@functools.lru_cache(maxsize=128)
+def ring_khop_cached(mesh: Mesh, n_nodes: int, n_hops: int,
+                     axis: str = "shard", masked: bool = False):
+    """Memoized make_ring_khop: repeat queries reuse the traced + compiled
+    shard_map program instead of re-jitting per call."""
+    return make_ring_khop(mesh, n_nodes, n_hops, axis, masked)
 
 
 def ring_khop_reference(seed_counts, edge_src, edge_dst, edge_ok,

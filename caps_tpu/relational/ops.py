@@ -109,6 +109,7 @@ class RelationalOperator(abc.ABC):
                 "op": name,
                 "seconds": time.perf_counter() - t0,
                 "rows": self._result[1].size,
+                **getattr(self, "_metric_extra", {}),
             })
         return self._result
 

@@ -90,7 +90,12 @@ class RelationalPlanner:
             env = dict(op.fields)
             group = [(n, e, env[n]) for n, e in op.group]
             aggs = [(n, a, env[n]) for n, a in op.aggregations]
-            return R.AggregateOp(ctx, parent, group, aggs)
+            default = R.AggregateOp(ctx, parent, group, aggs)
+            from caps_tpu.relational.count_pattern import (
+                try_plan_count_pushdown,
+            )
+            pushed = try_plan_count_pushdown(self, op, default)
+            return pushed if pushed is not None else default
         if isinstance(op, L.OrderBy):
             return R.OrderByOp(ctx, self.plan_op(op.parent), op.items)
         if isinstance(op, L.Skip):

@@ -1,0 +1,192 @@
+"""The SpMV count-pushdown planner rule (relational/count_pattern.py):
+count-only pattern chains must lower to dense-vector propagation with
+exact relationship-isomorphism corrections, match the local oracle on
+every backend, and ride the ring schedule on a mesh (round-1 VERDICT
+next-step #4; ref analog: okapi-logical LogicalOptimizer — reconstructed,
+mount empty; SURVEY.md §3.2)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from caps_tpu.backends.local.session import LocalCypherSession
+from caps_tpu.backends.tpu.session import TPUCypherSession
+from caps_tpu.okapi.config import EngineConfig
+from tests.util import make_graph
+
+
+def _random_graph(session, n=120, e=500, seed=7, self_loops=True):
+    rng = np.random.RandomState(seed)
+    nodes = {("P",): [{"_id": i, "name": f"n{i % 13}"} for i in range(n)]}
+    edges = [(int(rng.randint(n)), int(rng.randint(n)), {})
+             for _ in range(e)]
+    if self_loops:
+        edges += [(5, 5, {}), (5, 5, {}), (9, 9, {})]
+    return make_graph(session, nodes, {"K": edges})
+
+
+PUSHDOWN_QUERIES = [
+    "MATCH (a:P)-[:K]->(b) RETURN count(*) AS c",
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c) WHERE a.name = 'n5' RETURN count(*) AS c",
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c) RETURN count(*) AS c",
+    "MATCH (a:P)<-[:K]-(b) WHERE a.name = 'n3' RETURN count(*) AS c",
+    "MATCH (a:P)-[:K]->(b)<-[:K]-(c) WHERE a.name = 'n5' RETURN count(*) AS c",
+    "MATCH (a:P)<-[:K]-(b)-[:K]->(c) WHERE a.name = 'n5' RETURN count(*) AS c",
+    "MATCH (a:P)-[:K*1..2]->(b) WHERE a.name = 'n1' RETURN count(*) AS c",
+    "MATCH (a:P)-[:K*0..1]->(b) RETURN count(*) AS c",
+    "MATCH (a:P)-[:K*2..2]->(b) WHERE a.name = 'n5' RETURN count(*) AS c",
+    "MATCH (a:P)-[:K]->(b) WHERE a.name = 'n5' AND b.name = 'n3' "
+    "RETURN count(*) AS c",
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c) WHERE a.name = 'n5' AND b.name = 'n2' "
+    "AND c.name = 'n7' RETURN count(*) AS c",
+]
+
+
+def _ops(result):
+    return [m["op"] for m in result.metrics["operators"]]
+
+
+@pytest.mark.parametrize("backend_cfg", [
+    ("tpu", EngineConfig()),
+    ("sharded", EngineConfig(mesh_shape=(8,))),
+], ids=["tpu", "sharded"])
+@pytest.mark.parametrize("query", PUSHDOWN_QUERIES)
+def test_pushdown_matches_oracle(backend_cfg, query):
+    _, cfg = backend_cfg
+    oracle = _random_graph(LocalCypherSession())
+    session = TPUCypherSession(config=cfg)
+    g = _random_graph(session)
+    want = oracle.cypher(query).records.to_maps()
+    res = g.cypher(query)
+    assert res.records.to_maps() == want
+    assert "CountPattern" in _ops(res), res.plans["relational"]
+    strat = [m for m in res.metrics["operators"]
+             if m["op"] == "CountPattern"][0]["strategy"]
+    assert strat != "fallback-join"
+    assert session.fallback_count == 0
+
+
+def test_ring_strategy_on_mesh_uniform_chain():
+    session = TPUCypherSession(config=EngineConfig(mesh_shape=(8,)))
+    g = _random_graph(session)
+    res = g.cypher("MATCH (a:P)-[:K]->(b)-[:K]->(c) WHERE a.name = 'n5' "
+                   "RETURN count(*) AS c")
+    strat = [m for m in res.metrics["operators"]
+             if m["op"] == "CountPattern"][0]["strategy"]
+    assert strat == "ring"
+    # parity against the oracle
+    want = _random_graph(LocalCypherSession()).cypher(
+        "MATCH (a:P)-[:K]->(b)-[:K]->(c) WHERE a.name = 'n5' "
+        "RETURN count(*) AS c").records.to_maps()
+    assert res.records.to_maps() == want
+
+
+def test_mixed_direction_chain_not_ring_but_exact():
+    session = TPUCypherSession(config=EngineConfig(mesh_shape=(8,)))
+    g = _random_graph(session)
+    q = ("MATCH (a:P)-[:K]->(b)<-[:K]-(c) WHERE a.name = 'n5' "
+         "RETURN count(*) AS c")
+    res = g.cypher(q)
+    strat = [m for m in res.metrics["operators"]
+             if m["op"] == "CountPattern"][0]["strategy"]
+    assert strat == "spmv-sharded"
+    want = _random_graph(LocalCypherSession()).cypher(q).records.to_maps()
+    assert res.records.to_maps() == want
+
+
+NOT_LOWERED = [
+    # 3 fixed hops: correction is not closed-form
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c)-[:K]->(d) RETURN count(*) AS c",
+    # grouped aggregation
+    "MATCH (a:P)-[:K]->(b) RETURN a.name AS n, count(*) AS c",
+    # materializing query
+    "MATCH (a:P)-[:K]->(b) RETURN b.name AS n",
+    # var-length upper > 2
+    "MATCH (a:P)-[:K*1..3]->(b) RETURN count(*) AS c",
+    # undirected hop
+    "MATCH (a:P)-[:K]-(b) RETURN count(*) AS c",
+]
+
+
+@pytest.mark.parametrize("query", NOT_LOWERED)
+def test_unsupported_shapes_stay_on_join_path(query):
+    oracle = _random_graph(LocalCypherSession())
+    session = TPUCypherSession()
+    g = _random_graph(session)
+    res = g.cypher(query)
+    assert "CountPattern" not in _ops(res)
+    assert res.records.to_maps() == oracle.cypher(query).records.to_maps()
+
+
+def test_pushdown_disabled_by_config():
+    session = TPUCypherSession(config=EngineConfig(use_count_pushdown=False))
+    g = _random_graph(session)
+    res = g.cypher("MATCH (a:P)-[:K]->(b) RETURN count(*) AS c")
+    assert "CountPattern" not in _ops(res)
+
+
+def test_local_oracle_never_pushes_down():
+    g = _random_graph(LocalCypherSession())
+    res = g.cypher("MATCH (a:P)-[:K]->(b) RETURN count(*) AS c")
+    assert "CountPattern" not in _ops(res)
+
+
+def test_pushdown_rides_fused_replay():
+    session = TPUCypherSession()
+    g = _random_graph(session)
+    q = "MATCH (a:P)-[:K]->(b)-[:K]->(c) WHERE a.name = 'n5' RETURN count(*) AS c"
+    first = g.cypher(q).records.to_maps()
+    assert g.cypher(q).records.to_maps() == first
+    assert session.fused.replays == 1
+
+
+def test_dangling_edges_contribute_nothing():
+    """Edges referencing node ids with no node row must not create paths."""
+    session = TPUCypherSession()
+    g = make_graph(session,
+                   {("P",): [{"_id": 1}, {"_id": 2}]},
+                   {"K": [(1, 2, {}), (1, 77, {}), (77, 2, {})]})
+    oracle = make_graph(LocalCypherSession(),
+                        {("P",): [{"_id": 1}, {"_id": 2}]},
+                        {"K": [(1, 2, {}), (1, 77, {}), (77, 2, {})]})
+    q = "MATCH (a:P)-[:K]->(b:P) RETURN count(*) AS c"
+    assert (g.cypher(q).records.to_maps()
+            == oracle.cypher(q).records.to_maps())
+
+
+def test_dangling_edges_unlabeled_target():
+    """Fixed Expand joins the target node scan even for unlabeled vars, so
+    edges to ids without node rows match nothing; the lowering must mask
+    by node existence at every hop."""
+    nodes = {("P",): [{"_id": 1}, {"_id": 2}]}
+    rels = {"K": [(1, 2, {}), (1, 77, {}), (77, 2, {}), (2, 77, {})]}
+    oracle = make_graph(LocalCypherSession(), nodes, rels)
+    session = TPUCypherSession()
+    g = make_graph(session, nodes, rels)
+    for q in ["MATCH (a:P)-[:K]->(b) RETURN count(*) AS c",
+              "MATCH (a:P)-[:K]->(b)-[:K]->(c) RETURN count(*) AS c",
+              "MATCH (a:P)-[:K*1..2]->(b) RETURN count(*) AS c",
+              "MATCH (a:P)-[:K*2..2]->(b) RETURN count(*) AS c"]:
+        res = g.cypher(q)
+        assert "CountPattern" in _ops(res), q
+        assert res.records.to_maps() == oracle.cypher(q).records.to_maps(), q
+
+
+def test_untyped_and_typed_hops_edge_reuse_correction():
+    """An untyped hop scans every edge, so a typed hop's edges overlap it:
+    the r1 <> r2 correction must iterate the intersection scan (review
+    repro: oracle 0, naive pushdown 2)."""
+    nodes = {("P",): [{"_id": 1}, {"_id": 2}, {"_id": 3}]}
+    rels = {"K": [(1, 2, {}), (2, 3, {})]}
+    oracle = make_graph(LocalCypherSession(), nodes, rels)
+    session = TPUCypherSession()
+    g = make_graph(session, nodes, rels)
+    for q in [
+        "MATCH (a:P)-[r1]->(b)<-[r2:K]-(c) RETURN count(*) AS c",
+        "MATCH (a:P)-[r1:K]->(b)<-[r2]-(c) RETURN count(*) AS c",
+        "MATCH (a:P)-[r1]->(b)<-[r2]-(c) RETURN count(*) AS c",
+    ]:
+        res = g.cypher(q)
+        assert "CountPattern" in _ops(res), q
+        want = oracle.cypher(q).records.to_maps()
+        assert res.records.to_maps() == want, (q, want)
