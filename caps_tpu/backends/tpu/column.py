@@ -40,13 +40,32 @@ _DTYPES = {
 }
 
 
+def list_elem_kind(ctype: CypherType) -> Optional[str]:
+    """Element kind of a device-representable list type (values are packed
+    into the int32 list matrix): rel/node ids, int (int32-range), str
+    codes, bool.  None = no device representation (floats, nested lists,
+    mixed/unknown element types)."""
+    m = ctype.material
+    if not isinstance(m, _CTList):
+        return None
+    inner = m.inner.material if m.inner is not None else None
+    if isinstance(inner, (_CTRelationship, _CTNode)):
+        return "id"
+    if inner == CTInteger:
+        return "int"
+    if inner == CTString:
+        return "str"
+    if inner == CTBoolean:
+        return "bool"
+    return None
+
+
 def kind_for(ctype: CypherType) -> str:
     m = ctype.material
     if isinstance(m, (_CTNode, _CTRelationship)):
         return "id"
     if isinstance(m, _CTList):
-        inner = m.inner.material if m.inner is not None else None
-        if isinstance(inner, _CTRelationship):
+        if list_elem_kind(ctype) is not None:
             return "list"
         return "object"
     if m == CTInteger:
@@ -88,6 +107,7 @@ def make_column(values: List[Any], ctype: CypherType, capacity: int,
     if kind == "object":
         raise ValueError(f"type {ctype!r} has no device representation")
     if kind == "list":
+        ek = list_elem_kind(ctype) or "id"
         max_len = max((len(v) for v in values if v is not None), default=0)
         data_np = np.zeros((capacity, max(1, max_len)), dtype=np.int32)
         lens_np = np.zeros(capacity, dtype=np.int32)
@@ -97,7 +117,7 @@ def make_column(values: List[Any], ctype: CypherType, capacity: int,
             valid_np[i] = True
             lens_np[i] = len(v)
             for j, x in enumerate(v):
-                data_np[i, j] = int(x if not hasattr(x, "id") else x.id)
+                data_np[i, j] = encode_list_elem(x, ek, pool)
         return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np),
                       ctype, jnp.asarray(lens_np))
     dtype = _DTYPES[kind]
@@ -137,6 +157,26 @@ def _check_id(iv: int) -> int:
     return iv
 
 
+def encode_list_elem(x: Any, elem_kind: str, pool) -> int:
+    """Pack one list element into the int32 list matrix."""
+    if x is None:
+        raise ValueError("null list elements have no device representation")
+    if elem_kind == "str":
+        return pool.encode(x)
+    if elem_kind == "bool":
+        return int(bool(x))
+    iv = int(x if not hasattr(x, "id") else x.id)
+    return _check_id(iv)
+
+
+def decode_list_elem(code: int, elem_kind: str, pool) -> Any:
+    if elem_kind == "str":
+        return pool.decode(int(code))
+    if elem_kind == "bool":
+        return bool(code)
+    return int(code)
+
+
 def _make_column_native(values, kind: str, n: int):
     """Bulk ingest via the C++ host runtime (csrc/host_runtime.cpp); returns
     (data, valid) numpy views of length n, or None to use the Python loop.
@@ -173,9 +213,11 @@ def column_to_host(col: Column, n: int, pool) -> List[Any]:
     """Device column → host Python values (None for null)."""
     valid = np.asarray(col.valid[:n])
     if col.kind == "list":
+        ek = list_elem_kind(col.ctype) or "id"
         data = np.asarray(col.data[:n])
         lens = np.asarray(col.lens[:n])
-        return [list(map(int, data[i, :lens[i]])) if valid[i] else None
+        return [[decode_list_elem(x, ek, pool) for x in data[i, :lens[i]]]
+                if valid[i] else None
                 for i in range(n)]
     data = np.asarray(col.data[:n])
     out: List[Any] = []

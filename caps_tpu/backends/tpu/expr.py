@@ -52,9 +52,23 @@ class DeviceExprCompiler:
             if e.name not in self.params:
                 raise KeyError(f"missing parameter ${e.name}")
             v = self.params[e.name]
-            if isinstance(v, (list, tuple, dict)):
-                raise UnsupportedOnDevice("collection parameter value")
+            if isinstance(v, (list, tuple)):
+                return self._const_list(list(v))
+            if isinstance(v, dict):
+                raise UnsupportedOnDevice("map parameter value")
             return self._literal(v)
+        if isinstance(e, E.ListLit):
+            values = []
+            for item in e.items:
+                if isinstance(item, E.Lit):
+                    values.append(item.value)
+                elif isinstance(item, E.Param):
+                    values.append(self.params.get(item.name))
+                else:
+                    raise UnsupportedOnDevice("non-constant list literal")
+            return self._const_list(values)
+        if isinstance(e, E.Index):
+            return self._index(e)
         if isinstance(e, E.Id):
             return self.compile(e.entity)
 
@@ -128,6 +142,56 @@ class DeviceExprCompiler:
         ctype = from_python(v)
         return literal_column(v, ctype if v is not None else CTBoolean,
                               self.capacity, self.pool)
+
+    def _const_list(self, values) -> Column:
+        """A constant list value broadcast to every row (literal lists and
+        list parameters)."""
+        from caps_tpu.backends.tpu.column import encode_list_elem
+        from caps_tpu.okapi.types import CTList, from_python, join_all
+        if any(v is None for v in values):
+            raise UnsupportedOnDevice("null list elements")
+        inner = join_all(from_python(v) for v in values) if values \
+            else CTInteger
+        ctype = CTList(inner)
+        from caps_tpu.backends.tpu.column import list_elem_kind
+        ek = list_elem_kind(ctype)
+        if ek is None:
+            raise UnsupportedOnDevice(f"list of {inner!r} on device")
+        try:
+            codes = np.array([encode_list_elem(v, ek, self.pool)
+                              for v in values], dtype=np.int32)
+        except (ValueError, OverflowError) as ex:
+            raise UnsupportedOnDevice(str(ex))
+        L = max(1, len(values))
+        data = jnp.broadcast_to(
+            jnp.asarray(np.resize(codes, L) if len(values) else
+                        np.zeros(L, np.int32))[None, :],
+            (self.capacity, L))
+        lens = jnp.full(self.capacity, len(values), jnp.int32)
+        return Column("list", data, jnp.ones(self.capacity, bool), ctype,
+                      lens)
+
+    def _index(self, e) -> Column:
+        from caps_tpu.backends.tpu.column import _DTYPES, list_elem_kind
+        base = self.compile(e.expr)
+        if base.kind != "list":
+            raise UnsupportedOnDevice(f"indexing kind {base.kind}")
+        idx = self.compile(e.idx)
+        if idx.kind not in ("int", "id"):
+            raise UnsupportedOnDevice("non-integer list index")
+        ek = list_elem_kind(base.ctype)
+        if ek is None:
+            raise UnsupportedOnDevice("indexing host-only list")
+        inner = base.ctype.material.inner
+        i = idx.data.astype(jnp.int32)
+        i = jnp.where(i < 0, i + base.lens, i)  # negative = from the end
+        inb = (i >= 0) & (i < base.lens)
+        safe = jnp.clip(i, 0, base.data.shape[1] - 1)
+        vals = base.data[jnp.arange(self.capacity), safe]
+        valid = base.valid & idx.valid & inb
+        if ek == "bool":
+            return Column("bool", vals != 0, valid, inner)
+        return Column(ek, vals.astype(_DTYPES[ek]), valid, inner)
 
     def _bool(self, c: Column) -> Column:
         if c.kind != "bool":
@@ -263,6 +327,12 @@ class DeviceExprCompiler:
         r = self.compile(e.rhs)
         valid = l.valid & r.valid
         numeric = {"id", "int", "float"}
+        # Python-numeric semantics for booleans (True == 1), matching the
+        # oracle's behavior
+        if l.kind == "bool":
+            l = Column("int", l.data.astype(jnp.int64), l.valid, CTInteger)
+        if r.kind == "bool":
+            r = Column("int", r.data.astype(jnp.int64), r.valid, CTInteger)
         if l.kind not in numeric or r.kind not in numeric:
             raise UnsupportedOnDevice(
                 f"arithmetic on kinds {l.kind}/{r.kind}")

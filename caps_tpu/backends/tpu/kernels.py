@@ -22,9 +22,14 @@ import jax.numpy as jnp
 
 jax.config.update("jax_enable_x64", True)
 
-# Sentinels for join keys: nulls on either side must never match.
-_L_NULL = jnp.int64(-(2**62))
-_R_NULL = jnp.int64(-(2**62) + 1)
+# Sentinels for join keys: nulls (and NaNs) on either side must never
+# match anything.  They live in (-2^63, -2^63 + 2^52), the gap below any
+# monotone-bitcast float64 key (table._join_key) — only an int64 key of
+# exactly these pathological values could collide.
+_L_NULL = jnp.int64(-(2**63) + 1)
+_R_NULL = jnp.int64(-(2**63) + 2)
+_L_NAN = jnp.int64(-(2**63) + 3)
+_R_NAN = jnp.int64(-(2**63) + 4)
 _PAD = jnp.int64(2**62)
 
 

@@ -295,9 +295,19 @@ class DeviceTable(Table):
             return self._wrap_local(self.to_local().join(
                 other.to_local(), how, pairs))
 
-    def _join_key(self, col: Column) -> jnp.ndarray:
+    def _join_key(self, col: Column, side: str = "l") -> jnp.ndarray:
         if col.kind in ("id", "int", "str", "bool"):
             return col.data.astype(jnp.int64)
+        if col.kind == "float":
+            # Monotone float64 -> int64 bit transform: order-preserving, so
+            # the sort/search machinery works unchanged.  -0.0 is folded
+            # into +0.0 first (they must join), and NaN maps to a per-side
+            # sentinel so NaN never matches anything (incl. other NaNs).
+            x = jnp.where(col.data == 0.0, 0.0, col.data)
+            bits = x.view(jnp.int64)
+            key = jnp.where(bits < 0, jnp.int64(-(2**63)) - bits, bits)
+            nan_sent = K._L_NAN if side == "l" else K._R_NAN
+            return jnp.where(jnp.isnan(col.data), nan_sent, key)
         raise UnsupportedOnDevice(f"join key of kind {col.kind}")
 
     def _cached_right_sort(self, other: "DeviceTable", rcol: Column):
@@ -309,7 +319,7 @@ class DeviceTable(Table):
         if cached is not None and cached[0] == key:
             return cached[1]
         r_ok = rcol.valid & other.row_ok
-        res = K.sort_right(self._join_key(rcol), r_ok)
+        res = K.sort_right(self._join_key(rcol, side="r"), r_ok)
         rcol._join_sort = (key, res)
         return res
 
@@ -359,9 +369,12 @@ class DeviceTable(Table):
         for lc2, rc2 in pairs[1:]:
             a, b = out._cols[lc2], out._cols[rc2]
             if a.kind == "float" or b.kind == "float":
-                raise UnsupportedOnDevice("float join key")
-            eq = (a.data.astype(jnp.int64) == b.data.astype(jnp.int64)) \
-                & a.valid & b.valid
+                # NaN == NaN is False here, matching join semantics
+                eq = (a.data.astype(jnp.float64)
+                      == b.data.astype(jnp.float64)) & a.valid & b.valid
+            else:
+                eq = (a.data.astype(jnp.int64) == b.data.astype(jnp.int64)) \
+                    & a.valid & b.valid
             if left_join:
                 # unmatched left rows keep their single null-extended row
                 keep = eq | ~out._cols[rc2].valid
@@ -473,10 +486,8 @@ class DeviceTable(Table):
     def _group_device(self, by: Sequence[str],
                       aggs: Sequence[AggSpec]) -> "DeviceTable":
         for a in aggs:
-            if a.kind in ("collect", "percentile_cont", "percentile_disc"):
+            if a.kind in ("percentile_cont", "percentile_disc"):
                 raise UnsupportedOnDevice(f"{a.kind} aggregation")
-            if a.distinct:
-                raise UnsupportedOnDevice("DISTINCT aggregation")
         fast = self._group_dense_pallas(by, aggs)
         if fast is not None:
             return fast
@@ -513,9 +524,30 @@ class DeviceTable(Table):
                        else None)
             out[c] = g
         num_segments = out_cap
+
+        # DISTINCT aggregation: one extra stable sort per distinct column
+        # marks the FIRST occurrence of each (group, value); the agg then
+        # runs with that mask ANDed in (oracle semantics: dedupe keeps the
+        # first occurrence, so collect order matches too).
+        group_keys_sorted = [k[perm] for k in keys] if by else []
+        firstocc_cache: Dict[str, jnp.ndarray] = {}
+
+        def firstocc_for(col_name: str) -> jnp.ndarray:
+            if col_name not in firstocc_cache:
+                col = sorted_cols[col_name]
+                vk = _sort_keys(col, True, True, pool)
+                combined = group_keys_sorted + vk
+                p2 = K.sort_perm(combined, cap)
+                ch2 = K.neighbor_change_keys([k[p2] for k in combined])
+                firstocc_cache[col_name] = \
+                    jnp.zeros(cap, bool).at[p2].set(ch2)
+            return firstocc_cache[col_name]
+
         for a in aggs:
+            extra = firstocc_for(a.col) if a.distinct else None
             out[a.name] = self._one_agg(a, sorted_cols, seg_id, num_segments,
-                                        row_ok_sorted, n_groups)
+                                        row_ok_sorted, n_groups,
+                                        firstocc=extra, start_idx=start_idx)
         return DeviceTable(self.backend, out, n_groups)
 
     def _group_dense_pallas(self, by: Sequence[str],
@@ -529,6 +561,8 @@ class DeviceTable(Table):
         cfg = self.backend.config
         if not cfg.use_pallas or len(by) != 1:
             return None
+        if any(a.distinct or a.kind == "collect" for a in aggs):
+            return None  # sorted path handles distinct/collect
         key_col = self._cols.get(by[0])
         if key_col is None or key_col.kind not in ("str", "bool"):
             return None
@@ -609,7 +643,8 @@ class DeviceTable(Table):
         return dense._compact(counts_all > 0)
 
     def _one_agg(self, a: AggSpec, cols: Dict[str, Column], seg_id,
-                 num_segments: int, row_ok, n_groups: int) -> Column:
+                 num_segments: int, row_ok, n_groups: int,
+                 firstocc=None, start_idx=None) -> Column:
         group_live = jnp.arange(num_segments) < n_groups
         if a.kind == "count_star":
             data = K.sorted_segment_agg(row_ok, row_ok, seg_id,
@@ -617,9 +652,14 @@ class DeviceTable(Table):
             return Column("int", data, group_live, CTInteger)
         col = cols[a.col]
         ok = col.valid & row_ok
+        if firstocc is not None:
+            ok = ok & firstocc
         if a.kind == "count":
             data = K.sorted_segment_agg(ok, ok, seg_id, num_segments, "count")
             return Column("int", data, group_live, CTInteger)
+        if a.kind == "collect":
+            return self._collect_agg(a, col, ok, seg_id, num_segments,
+                                     group_live, start_idx)
         if col.kind == "list":
             raise UnsupportedOnDevice(f"{a.kind} over list column")
         if a.kind == "first":
@@ -673,6 +713,42 @@ class DeviceTable(Table):
             return Column("float", data, (counts > 0) & group_live, CTFloat)
         raise UnsupportedOnDevice(f"aggregation {a.kind}")
 
+    def _collect_agg(self, a: AggSpec, col: Column, ok, seg_id,
+                     num_segments: int, group_live, start_idx) -> Column:
+        """collect(x) on device: per-group value lists laid out as a
+        (groups, max_len) int32 matrix via one flat scatter.  Kept rows
+        are in group-sorted (stable) order, i.e. original row order within
+        each group — the oracle's collect order."""
+        from caps_tpu.backends.tpu.column import list_elem_kind
+        if col.kind not in ("id", "int", "str", "bool"):
+            raise UnsupportedOnDevice(f"collect over kind {col.kind}")
+        if a.result_type is None or list_elem_kind(a.result_type) is None:
+            raise UnsupportedOnDevice("collect to host-only list type")
+        if col.kind == "int":
+            lo = self.backend.consume_count(
+                jnp.min(jnp.where(ok, col.data, 0)))
+            hi = self.backend.consume_count(
+                jnp.max(jnp.where(ok, col.data, 0)))
+            if not (-2**31 < lo and hi < 2**31):
+                raise UnsupportedOnDevice("collect of int64-range values")
+        counts = K.segment_agg(col.data, ok, seg_id, num_segments, "count")
+        max_len = self.backend.consume_count(
+            jnp.max(counts) if num_segments else jnp.int64(0))
+        L = max(1, int(max_len))
+        # rank of each kept row within its segment
+        c = jnp.cumsum(ok.astype(jnp.int32))
+        sp = start_idx[jnp.clip(seg_id, 0, start_idx.shape[0] - 1)]
+        base = jnp.where(sp > 0, c[jnp.maximum(sp - 1, 0)], 0)
+        within = c - 1 - base
+        sentinel = num_segments * L
+        flat_idx = jnp.where(ok, seg_id * L + within, sentinel)
+        vals32 = (col.data != 0).astype(jnp.int32) if col.kind == "bool" \
+            else col.data.astype(jnp.int32)
+        flat = jnp.zeros(sentinel + 1, jnp.int32).at[flat_idx].set(vals32)
+        data = flat[:-1].reshape(num_segments, L)
+        return Column("list", data, group_live, a.result_type,
+                      counts.astype(jnp.int32))
+
     # -- lists -----------------------------------------------------------
 
     def explode(self, list_col: str, out_col: str,
@@ -691,7 +767,16 @@ class DeviceTable(Table):
         rest = {c: v for c, v in self._cols.items() if c != list_col}
         out_cols = _gather_cols(rest, row)
         values = col.data[row, jnp.clip(within, 0, col.data.shape[1] - 1)]
-        out_cols[out_col] = Column("id", values, out_valid, out_type)
+        out_kind = kind_for(out_type)
+        if out_kind == "object":
+            return self._fallback("explode to host-only element type"
+                                  ).explode(list_col, out_col, out_type)
+        from caps_tpu.backends.tpu.column import _DTYPES
+        if out_kind == "bool":
+            values = values != 0
+        else:
+            values = values.astype(_DTYPES[out_kind])
+        out_cols[out_col] = Column(out_kind, values, out_valid, out_type)
         return DeviceTable(self.backend, out_cols, total)
 
     def pack_list(self, cols: Sequence[str], out_col: str,
@@ -843,8 +928,14 @@ class DeviceTableFactory(TableFactory):
             if kind_for(ctype) == "object":
                 local = self._local.from_columns(data, types)
                 return DeviceTable(self.backend, local=local)
-            cols[c] = self.backend.place_column(
-                make_column(list(values), ctype, cap, self.backend.pool))
+            try:
+                col = make_column(list(values), ctype, cap, self.backend.pool)
+            except ValueError:
+                # values the device encoding rejects (int32-overflowing
+                # list elements, null-in-list, oversized ids): host table
+                local = self._local.from_columns(data, types)
+                return DeviceTable(self.backend, local=local)
+            cols[c] = self.backend.place_column(col)
         return DeviceTable(self.backend, cols, n)
 
     def unit(self) -> DeviceTable:
