@@ -173,12 +173,18 @@ def segment_agg(values, ok, seg_id, num_segments: int, kind: str):
     if kind == "sum":
         v = jnp.where(ok, values, 0)
         return jax.ops.segment_sum(v, seg_id, num_segments)
-    if kind == "min":
-        big = jnp.array(jnp.inf if values.dtype.kind == "f" else
-                        jnp.iinfo(values.dtype).max, values.dtype)
-        v = jnp.where(ok, values, big)
-        return jax.ops.segment_min(v, seg_id, num_segments)
-    if kind == "max":
+    if kind in ("min", "max"):
+        # An all-null column (e.g. aggregation over an empty MATCH) can
+        # arrive as bool; jnp.iinfo rejects 'b', and min/max over bools is
+        # well-defined via int promotion, so widen before picking the
+        # identity element.
+        if values.dtype.kind == "b":
+            values = values.astype(jnp.int64)
+        if kind == "min":
+            big = jnp.array(jnp.inf if values.dtype.kind == "f" else
+                            jnp.iinfo(values.dtype).max, values.dtype)
+            v = jnp.where(ok, values, big)
+            return jax.ops.segment_min(v, seg_id, num_segments)
         small = jnp.array(-jnp.inf if values.dtype.kind == "f" else
                           jnp.iinfo(values.dtype).min, values.dtype)
         v = jnp.where(ok, values, small)

@@ -6,11 +6,12 @@ SURVEY.md §2): filter = mask + compact, join = sort-merge + segmented
 expansion, aggregate = sort + segment reductions, orderBy = multi-key
 lexicographic lax.sort — all shape-static and jit-cached per bucket.
 
-Operators without a device path yet (collect aggregation, DISTINCT
-aggregates, collection-valued expressions, …) raise
-:class:`UnsupportedOnDevice`; the table then converts to the local oracle
-backend and continues there.  Fallbacks are counted on the backend object
-so benchmarks can assert the hot path stayed on-device.
+Collect aggregation runs on-device (sorted segment gather); the remaining
+operators without a device path (DISTINCT aggregates, some
+collection-valued expressions, …) raise :class:`UnsupportedOnDevice`; the
+table then converts to the local oracle backend and continues there.
+Fallbacks are counted on the backend object so benchmarks can assert the
+hot path stayed on-device.
 """
 from __future__ import annotations
 

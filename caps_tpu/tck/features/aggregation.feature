@@ -38,6 +38,40 @@ Feature: Aggregation
       | c |
       | 0 |
 
+  Scenario: min max sum avg on an empty match return null
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n:Nope) RETURN count(*) AS c, min(n.v) AS mn, max(n.v) AS mx, sum(n.v) AS s, avg(n.v) AS a
+      """
+    Then the result should be, in any order:
+      | c | mn   | mx   | s | a    |
+      | 0 | null | null | 0 | null |
+
+  Scenario: min max over an all-null property return null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN min(p.x) AS mn, max(p.x) AS mx
+      """
+    Then the result should be, in any order:
+      | mn   | mx   |
+      | null | null |
+
+  Scenario: collect on an empty match returns the empty list
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n:Nope) RETURN collect(n.v) AS l
+      """
+    Then the result should be, in any order:
+      | l  |
+      | [] |
+
   Scenario: sum avg min max over a grouping key
     Given an empty graph
     And having executed:

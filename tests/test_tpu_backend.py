@@ -62,13 +62,15 @@ def test_hot_path_has_no_fallbacks():
     assert session.fallback_count == before, session.backend.fallback_reasons
 
 
-def test_fallback_is_counted_for_collect():
+def test_collect_stays_on_device():
     session = TPUCypherSession()
     g = create_graph(session, SOCIAL)
     before = session.fallback_count
     rows = g.cypher("MATCH (a:Person) RETURN collect(a.age) AS l").records.to_maps()
     assert sorted(rows[0]["l"]) == [23, 42, 1984]
-    assert session.fallback_count > before  # collect has no device path yet
+    # collect gained a device path (table.py device collect); it must no
+    # longer bounce the query to the oracle backend.
+    assert session.fallback_count == before, session.backend.fallback_reasons
 
 
 def test_string_pool_roundtrip():
