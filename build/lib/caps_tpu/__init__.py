@@ -1,0 +1,54 @@
+"""caps_tpu — a TPU-native openCypher property-graph query engine.
+
+A brand-new implementation of the capabilities of CAPS
+(cypher-for-apache-spark / "okapi"-era Morpheus): an openCypher front-end and
+backend-agnostic IR -> logical -> relational planning stack over a columnar
+``Table`` SPI, with the physical backend implemented in JAX/XLA/Pallas for
+TPU — property graphs resident in HBM as CSR/COO adjacency plus
+dictionary-encoded property columns, pattern matching lowered to gathers,
+sort-merge joins and segmented aggregations, sharded over a device mesh with
+ICI collectives.
+
+Layering (mirrors the reference's okapi split — see SURVEY.md §1):
+
+    okapi/       value model, type lattice, schema, graph/session API, PGDS SPI
+    frontend/    openCypher lexer + recursive-descent parser + semantic checks
+    ir/          typed expression tree, query blocks, pattern, IR builder
+    logical/     logical operator algebra, planner, optimizer
+    relational/  RecordHeader, Table SPI, relational operators, planner, graphs
+    backends/    numpy (reference oracle) and tpu (JAX) Table implementations
+    ops/         Pallas TPU kernels for the hot operators
+    parallel/    device mesh, collectives, sharded tables
+    io/          property-graph data sources (session, filesystem)
+    testing/     CREATE-string graph factory, Bag comparison harness
+"""
+
+from caps_tpu.okapi.types import (  # noqa: F401
+    CTAny, CTBoolean, CTFloat, CTInteger, CTList, CTMap, CTNode, CTNull,
+    CTRelationship, CTString, CTVoid, CypherType,
+)
+from caps_tpu.okapi.values import (  # noqa: F401
+    CypherList, CypherMap, CypherNode, CypherRelationship, CypherValue,
+)
+from caps_tpu.okapi.schema import Schema  # noqa: F401
+from caps_tpu.okapi.graph import (  # noqa: F401
+    GraphName, Namespace, QualifiedGraphName,
+)
+
+__version__ = "0.1.0"
+
+
+def local_session(backend: str = "tpu", **kwargs):
+    """Create a local Cypher session (analog of ``CAPSSession.local()``).
+
+    backend="tpu" returns a :class:`~caps_tpu.backends.tpu.session.TPUCypherSession`;
+    backend="local" returns the pure-Python oracle session used as the
+    parity reference in tests.
+    """
+    if backend in ("local", "oracle"):
+        from caps_tpu.backends.local.session import LocalCypherSession
+        return LocalCypherSession(**kwargs)
+    if backend == "tpu":
+        from caps_tpu.backends.tpu.session import TPUCypherSession
+        return TPUCypherSession(**kwargs)
+    raise ValueError(f"unknown backend {backend!r}")

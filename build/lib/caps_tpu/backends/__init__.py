@@ -1,0 +1,2 @@
+"""Table SPI backends: ``local`` (pure-Python correctness oracle) and
+``tpu`` (JAX/XLA/Pallas device backend)."""

@@ -1,0 +1,22 @@
+"""The TPU backend: the ``Table`` SPI over HBM-resident columnar data.
+
+Replaces the role of the reference's ``SparkTable``/``SparkSQLExprMapper``
+(SURVEY.md §2) with a JAX/XLA execution path designed for the hardware:
+
+  * columns are device arrays with validity masks, padded to bucketed
+    static capacities so each operator compiles once per shape bucket;
+  * strings are dictionary-encoded host-side (``StringPool``) — the device
+    only sees int32 codes, plus order-preserving rank arrays and per-query
+    predicate lookup tables;
+  * joins are sort-merge (lax.sort + searchsorted + segmented expansion),
+    aggregations are sort + segment reductions — shapes static throughout;
+  * operators without a device implementation yet fall back to the local
+    oracle backend explicitly (counted, so benchmarks can assert the hot
+    path never falls back).
+"""
+import jax
+
+# Cypher integers/floats are 64-bit; enable before any sibling module
+# evaluates jnp dtypes.  Entity ids stay int32 on the hot path.
+jax.config.update("jax_enable_x64", True)
+

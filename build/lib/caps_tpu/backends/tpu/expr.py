@@ -1,0 +1,455 @@
+"""Expr → device column compiler.
+
+The TPU analog of the reference's ``SparkSQLExprMapper`` (SURVEY.md §2):
+compiles okapi expressions to (data, valid) column computations in jnp with
+3-valued null logic carried in validity masks.  String semantics ride the
+StringPool: equality on codes, ordering via the rank array, literal string
+predicates via per-pool lookup tables, unary string functions via mapping
+LUTs.  Anything without a device representation raises
+:class:`UnsupportedOnDevice`, which flips the table into host-fallback mode.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from caps_tpu.backends.tpu.column import Column, kind_for
+from caps_tpu.ir import exprs as E
+from caps_tpu.okapi.types import (
+    CTBoolean, CTFloat, CTInteger, CTString, CypherType,
+)
+from caps_tpu.relational.header import RecordHeader
+
+
+class UnsupportedOnDevice(Exception):
+    """Raised when an expression/operator has no device path (yet); the
+    table falls back to the local oracle backend and counts the event."""
+
+
+class DeviceExprCompiler:
+    def __init__(self, columns: Mapping[str, Column], capacity: int,
+                 header: RecordHeader, params: Mapping[str, Any], pool,
+                 row_ok: jnp.ndarray):
+        self.columns = columns
+        self.capacity = capacity
+        self.header = header
+        self.params = dict(params)
+        self.pool = pool
+        self.row_ok = row_ok
+
+    # ------------------------------------------------------------------
+
+    def compile(self, e: E.Expr) -> Column:  # noqa: C901
+        if self.header.has(e):
+            col = self.columns[self.header.column(e)]
+            return col
+
+        if isinstance(e, E.Lit):
+            return self._literal(e.value)
+        if isinstance(e, E.Param):
+            if e.name not in self.params:
+                raise KeyError(f"missing parameter ${e.name}")
+            v = self.params[e.name]
+            if isinstance(v, (list, tuple)):
+                return self._const_list(list(v))
+            if isinstance(v, dict):
+                raise UnsupportedOnDevice("map parameter value")
+            return self._literal(v)
+        if isinstance(e, E.ListLit):
+            values = []
+            for item in e.items:
+                if isinstance(item, E.Lit):
+                    values.append(item.value)
+                elif isinstance(item, E.Param):
+                    values.append(self.params.get(item.name))
+                else:
+                    raise UnsupportedOnDevice("non-constant list literal")
+            return self._const_list(values)
+        if isinstance(e, E.Index):
+            return self._index(e)
+        if isinstance(e, E.Id):
+            return self.compile(e.entity)
+
+        if isinstance(e, E.Ands):
+            return self._and_or(e.exprs, is_and=True)
+        if isinstance(e, E.Ors):
+            return self._and_or(e.exprs, is_and=False)
+        if isinstance(e, E.Not):
+            c = self._bool(self.compile(e.expr))
+            return Column("bool", ~c.data, c.valid, CTBoolean)
+        if isinstance(e, E.Xor):
+            l = self._bool(self.compile(e.lhs))
+            r = self._bool(self.compile(e.rhs))
+            return Column("bool", l.data ^ r.data, l.valid & r.valid, CTBoolean)
+        if isinstance(e, E.IsNull):
+            c = self.compile(e.expr)
+            return Column("bool", ~c.valid, jnp.ones(self.capacity, bool),
+                          CTBoolean)
+        if isinstance(e, E.IsNotNull):
+            c = self.compile(e.expr)
+            return Column("bool", c.valid, jnp.ones(self.capacity, bool),
+                          CTBoolean)
+        if isinstance(e, E.Exists):
+            c = self.compile(e.expr)
+            return Column("bool", c.valid, jnp.ones(self.capacity, bool),
+                          CTBoolean)
+
+        if isinstance(e, (E.Equals, E.NotEquals)):
+            return self._equality(e)
+        if isinstance(e, (E.LessThan, E.LessThanOrEqual, E.GreaterThan,
+                          E.GreaterThanOrEqual)):
+            return self._ordering(e)
+        if isinstance(e, (E.StartsWith, E.EndsWith, E.Contains, E.RegexMatch)):
+            return self._string_predicate(e)
+        if isinstance(e, E.In):
+            return self._in_list(e)
+
+        if isinstance(e, (E.Add, E.Subtract, E.Multiply, E.Divide, E.Modulo,
+                          E.Power)):
+            return self._arith(e)
+        if isinstance(e, E.Negate):
+            c = self.compile(e.expr)
+            if c.kind not in ("int", "float", "id"):
+                raise UnsupportedOnDevice("negate non-numeric")
+            return Column(c.kind, -c.data, c.valid, c.ctype)
+
+        if isinstance(e, E.CaseExpr):
+            return self._case(e)
+        if isinstance(e, E.Coalesce):
+            cols = [self.compile(x) for x in e.exprs]
+            out = cols[-1]
+            for c in reversed(cols[:-1]):
+                c2, o2 = self._promote(c, out)
+                out = Column(c2.kind,
+                             jnp.where(c2.valid, c2.data, o2.data),
+                             c2.valid | o2.valid, c2.ctype)
+            return out
+        if isinstance(e, E.FunctionExpr):
+            return self._function(e)
+        if isinstance(e, E.Type):
+            raise UnsupportedOnDevice(f"{e!r} not in header")
+        raise UnsupportedOnDevice(f"no device rule for {type(e).__name__}")
+
+    # -- helpers -------------------------------------------------------
+
+    def _literal(self, v: Any) -> Column:
+        from caps_tpu.backends.tpu.column import literal_column
+        from caps_tpu.okapi.types import from_python
+        if isinstance(v, (list, tuple, dict)):
+            raise UnsupportedOnDevice("collection literal")
+        ctype = from_python(v)
+        return literal_column(v, ctype if v is not None else CTBoolean,
+                              self.capacity, self.pool)
+
+    def _const_list(self, values) -> Column:
+        """A constant list value broadcast to every row (literal lists and
+        list parameters)."""
+        from caps_tpu.backends.tpu.column import encode_list_elem
+        from caps_tpu.okapi.types import CTList, from_python, join_all
+        if any(v is None for v in values):
+            raise UnsupportedOnDevice("null list elements")
+        inner = join_all(from_python(v) for v in values) if values \
+            else CTInteger
+        ctype = CTList(inner)
+        from caps_tpu.backends.tpu.column import list_elem_kind
+        ek = list_elem_kind(ctype)
+        if ek is None:
+            raise UnsupportedOnDevice(f"list of {inner!r} on device")
+        try:
+            codes = np.array([encode_list_elem(v, ek, self.pool)
+                              for v in values], dtype=np.int32)
+        except (ValueError, OverflowError) as ex:
+            raise UnsupportedOnDevice(str(ex))
+        L = max(1, len(values))
+        data = jnp.broadcast_to(
+            jnp.asarray(np.resize(codes, L) if len(values) else
+                        np.zeros(L, np.int32))[None, :],
+            (self.capacity, L))
+        lens = jnp.full(self.capacity, len(values), jnp.int32)
+        return Column("list", data, jnp.ones(self.capacity, bool), ctype,
+                      lens)
+
+    def _index(self, e) -> Column:
+        from caps_tpu.backends.tpu.column import _DTYPES, list_elem_kind
+        base = self.compile(e.expr)
+        if base.kind != "list":
+            raise UnsupportedOnDevice(f"indexing kind {base.kind}")
+        idx = self.compile(e.idx)
+        if idx.kind not in ("int", "id"):
+            raise UnsupportedOnDevice("non-integer list index")
+        ek = list_elem_kind(base.ctype)
+        if ek is None:
+            raise UnsupportedOnDevice("indexing host-only list")
+        inner = base.ctype.material.inner
+        i = idx.data.astype(jnp.int32)
+        i = jnp.where(i < 0, i + base.lens, i)  # negative = from the end
+        inb = (i >= 0) & (i < base.lens)
+        safe = jnp.clip(i, 0, base.data.shape[1] - 1)
+        vals = base.data[jnp.arange(self.capacity), safe]
+        valid = base.valid & idx.valid & inb
+        if ek == "bool":
+            return Column("bool", vals != 0, valid, inner)
+        return Column(ek, vals.astype(_DTYPES[ek]), valid, inner)
+
+    def _bool(self, c: Column) -> Column:
+        if c.kind != "bool":
+            raise UnsupportedOnDevice(f"expected boolean, got {c.kind}")
+        return c
+
+    def _and_or(self, exprs, is_and: bool) -> Column:
+        cols = [self._bool(self.compile(x)) for x in exprs]
+        decided = jnp.zeros(self.capacity, bool)   # any False (AND) / True (OR)
+        any_null = jnp.zeros(self.capacity, bool)
+        for c in cols:
+            hit = c.valid & (~c.data if is_and else c.data)
+            decided = decided | hit
+            any_null = any_null | ~c.valid
+        if is_and:
+            data = ~decided & ~any_null
+            valid = decided | ~any_null
+        else:
+            data = decided
+            valid = decided | ~any_null
+        return Column("bool", data, valid, CTBoolean)
+
+    def _promote(self, l: Column, r: Column):
+        """Promote two columns to a common comparable kind."""
+        if l.kind == r.kind:
+            return l, r
+        numeric = {"id", "int", "float"}
+        if l.kind in numeric and r.kind in numeric:
+            if "float" in (l.kind, r.kind):
+                return l.astype_kind("float"), r.astype_kind("float")
+            return l.astype_kind("int"), r.astype_kind("int")
+        raise UnsupportedOnDevice(f"cannot compare kinds {l.kind}/{r.kind}")
+
+    def _equality(self, e) -> Column:
+        l = self.compile(e.lhs)
+        r = self.compile(e.rhs)
+        valid = l.valid & r.valid
+        try:
+            l2, r2 = self._promote(l, r)
+            eq = l2.data == r2.data
+        except UnsupportedOnDevice:
+            eq = jnp.zeros(self.capacity, bool)  # mismatched kinds: never equal
+        if isinstance(e, E.NotEquals):
+            eq = ~eq
+        return Column("bool", eq, valid, CTBoolean)
+
+    def _ordering(self, e) -> Column:
+        l = self.compile(e.lhs)
+        r = self.compile(e.rhs)
+        valid = l.valid & r.valid
+        if l.kind == "str" and r.kind == "str":
+            rank = jnp.asarray(self.pool.rank_array())
+            ld = rank[jnp.clip(l.data, 0, max(0, rank.shape[0] - 1))] \
+                if rank.shape[0] else l.data
+            rd = rank[jnp.clip(r.data, 0, max(0, rank.shape[0] - 1))] \
+                if rank.shape[0] else r.data
+        else:
+            l2, r2 = self._promote(l, r)
+            if l2.kind == "bool":
+                raise UnsupportedOnDevice("boolean ordering")
+            ld, rd = l2.data, r2.data
+        if isinstance(e, E.LessThan):
+            out = ld < rd
+        elif isinstance(e, E.LessThanOrEqual):
+            out = ld <= rd
+        elif isinstance(e, E.GreaterThan):
+            out = ld > rd
+        else:
+            out = ld >= rd
+        return Column("bool", out, valid, CTBoolean)
+
+    def _string_predicate(self, e) -> Column:
+        l = self.compile(e.lhs)
+        if l.kind != "str":
+            raise UnsupportedOnDevice("string predicate on non-string")
+        if not isinstance(e.rhs, (E.Lit, E.Param)):
+            raise UnsupportedOnDevice("string predicate needs literal rhs")
+        rhs = e.rhs.value if isinstance(e.rhs, E.Lit) else self.params[e.rhs.name]
+        if not isinstance(rhs, str):
+            raise UnsupportedOnDevice("string predicate rhs not a string")
+        if isinstance(e, E.StartsWith):
+            lut = self.pool.starts_with_lut(rhs)
+        elif isinstance(e, E.EndsWith):
+            lut = self.pool.ends_with_lut(rhs)
+        elif isinstance(e, E.Contains):
+            lut = self.pool.contains_lut(rhs)
+        else:
+            lut = self.pool.regex_lut(rhs)
+        if lut.shape[0] == 0:
+            return Column("bool", jnp.zeros(self.capacity, bool), l.valid,
+                          CTBoolean)
+        table = jnp.asarray(lut)
+        data = table[jnp.clip(l.data, 0, table.shape[0] - 1)]
+        return Column("bool", data, l.valid, CTBoolean)
+
+    def _in_list(self, e) -> Column:
+        l = self.compile(e.lhs)
+        if isinstance(e.rhs, E.ListLit) and all(
+                isinstance(i, E.Lit) for i in e.rhs.items):
+            values = [i.value for i in e.rhs.items]
+        elif isinstance(e.rhs, E.Param):
+            values = self.params.get(e.rhs.name)
+            if not isinstance(values, (list, tuple)):
+                raise UnsupportedOnDevice("IN parameter is not a list")
+        else:
+            raise UnsupportedOnDevice("IN needs a literal/parameter list")
+        has_null = any(v is None for v in values)
+        values = [v for v in values if v is not None]
+        if l.kind == "str":
+            arr = jnp.asarray(np.array(
+                [self.pool.encode(v) for v in values if isinstance(v, str)],
+                dtype=np.int32))
+        elif l.kind in ("int", "id"):
+            arr = jnp.asarray(np.array(
+                [int(v) for v in values
+                 if isinstance(v, (int, float)) and not isinstance(v, bool)
+                 and float(v) == int(v)], dtype=np.int64))
+            l = l.astype_kind("int")
+        elif l.kind == "float":
+            arr = jnp.asarray(np.array(
+                [float(v) for v in values
+                 if isinstance(v, (int, float)) and not isinstance(v, bool)],
+                dtype=np.float64))
+        else:
+            raise UnsupportedOnDevice(f"IN over kind {l.kind}")
+        found = jnp.isin(l.data, arr) if arr.shape[0] else \
+            jnp.zeros(self.capacity, bool)
+        valid = l.valid & (found | (not has_null))
+        return Column("bool", found, valid, CTBoolean)
+
+    def _arith(self, e) -> Column:
+        l = self.compile(e.lhs)
+        r = self.compile(e.rhs)
+        valid = l.valid & r.valid
+        numeric = {"id", "int", "float"}
+        # Python-numeric semantics for booleans (True == 1), matching the
+        # oracle's behavior
+        if l.kind == "bool":
+            l = Column("int", l.data.astype(jnp.int64), l.valid, CTInteger)
+        if r.kind == "bool":
+            r = Column("int", r.data.astype(jnp.int64), r.valid, CTInteger)
+        if l.kind not in numeric or r.kind not in numeric:
+            raise UnsupportedOnDevice(
+                f"arithmetic on kinds {l.kind}/{r.kind}")
+        if isinstance(e, E.Power):
+            lf, rf = l.astype_kind("float"), r.astype_kind("float")
+            return Column("float", lf.data ** rf.data, valid, CTFloat)
+        both_int = l.kind != "float" and r.kind != "float"
+        if both_int:
+            a = l.astype_kind("int").data
+            b = r.astype_kind("int").data
+            if isinstance(e, E.Divide):
+                bb = jnp.where(b == 0, 1, b)
+                q = jnp.sign(a) * jnp.sign(b) * (jnp.abs(a) // jnp.abs(bb))
+                return Column("int", q, valid & (b != 0), CTInteger)
+            if isinstance(e, E.Modulo):
+                bb = jnp.where(b == 0, 1, b)
+                m = jnp.sign(a) * (jnp.abs(a) % jnp.abs(bb))
+                return Column("int", m, valid & (b != 0), CTInteger)
+            ops: Dict[type, Callable] = {E.Add: jnp.add, E.Subtract: jnp.subtract,
+                                         E.Multiply: jnp.multiply}
+            return Column("int", ops[type(e)](a, b), valid, CTInteger)
+        a = l.astype_kind("float").data
+        b = r.astype_kind("float").data
+        if isinstance(e, E.Divide):
+            bb = jnp.where(b == 0.0, 1.0, b)
+            return Column("float", a / bb, valid & (b != 0.0), CTFloat)
+        if isinstance(e, E.Modulo):
+            m = jnp.sign(a) * (jnp.abs(a) % jnp.abs(jnp.where(b == 0, 1.0, b)))
+            return Column("float", m, valid & (b != 0.0), CTFloat)
+        ops = {E.Add: jnp.add, E.Subtract: jnp.subtract, E.Multiply: jnp.multiply}
+        return Column("float", ops[type(e)](a, b), valid, CTFloat)
+
+    def _case(self, e: E.CaseExpr) -> Column:
+        conds = [self._bool(self.compile(c)) for c in e.conditions]
+        vals = [self.compile(v) for v in e.values]
+        default = self.compile(e.default) if e.default is not None else None
+        out = default
+        if out is None:
+            proto = vals[0]
+            out = Column(proto.kind, jnp.zeros_like(proto.data),
+                         jnp.zeros(self.capacity, bool), proto.ctype)
+        for c, v in zip(reversed(conds), reversed(vals)):
+            v2, o2 = self._promote(v, out)
+            take = c.valid & c.data
+            out = Column(v2.kind, jnp.where(take, v2.data, o2.data),
+                         jnp.where(take, v2.valid, o2.valid), v2.ctype)
+        return out
+
+    def _function(self, e: E.FunctionExpr) -> Column:  # noqa: C901
+        name = e.name
+        args = [self.compile(a) for a in e.args]
+
+        unary_float = {"sqrt": jnp.sqrt, "exp": jnp.exp, "log": jnp.log,
+                       "log10": jnp.log10, "sin": jnp.sin, "cos": jnp.cos,
+                       "tan": jnp.tan, "atan": jnp.arctan, "asin": jnp.arcsin,
+                       "acos": jnp.arccos, "ceil": jnp.ceil,
+                       "floor": jnp.floor}
+        if name in unary_float:
+            c = args[0].astype_kind("float")
+            return Column("float", unary_float[name](c.data), c.valid, CTFloat)
+        if name == "round":
+            c = args[0].astype_kind("float")
+            return Column("float", jnp.floor(c.data + 0.5), c.valid, CTFloat)
+        if name == "abs":
+            c = args[0]
+            if c.kind not in ("int", "float", "id"):
+                raise UnsupportedOnDevice("abs non-numeric")
+            return Column(c.kind, jnp.abs(c.data), c.valid, c.ctype)
+        if name == "sign":
+            c = args[0]
+            return Column("int", jnp.sign(c.data).astype(jnp.int64), c.valid,
+                          CTInteger)
+        if name in ("tointeger", "toint"):
+            c = args[0]
+            if c.kind in ("int", "id"):
+                return c.astype_kind("int")
+            if c.kind == "float":
+                return Column("int", c.data.astype(jnp.int64), c.valid,
+                              CTInteger)
+            raise UnsupportedOnDevice("toInteger on non-numeric")
+        if name == "tofloat":
+            c = args[0]
+            if c.kind in ("int", "id", "float"):
+                return c.astype_kind("float")
+            raise UnsupportedOnDevice("toFloat on non-numeric")
+        if name in ("toupper", "touppercase", "tolower", "tolowercase",
+                    "trim", "ltrim", "rtrim", "reverse"):
+            c = args[0]
+            if c.kind != "str":
+                raise UnsupportedOnDevice(f"{name} on non-string")
+            fns = {"toupper": str.upper, "touppercase": str.upper,
+                   "tolower": str.lower, "tolowercase": str.lower,
+                   "trim": str.strip, "ltrim": str.lstrip,
+                   "rtrim": str.rstrip, "reverse": lambda s: s[::-1]}
+            lut = self.pool.map_lut(name, fns[name])
+            if lut.shape[0] == 0:
+                return c
+            table = jnp.asarray(lut)
+            return Column("str", table[jnp.clip(c.data, 0, table.shape[0] - 1)],
+                          c.valid, CTString)
+        if name in ("size", "length"):
+            c = args[0]
+            if c.kind == "list":
+                return Column("int", c.lens.astype(jnp.int64), c.valid,
+                              CTInteger)
+            if c.kind == "str":
+                lengths = np.array([len(s) for s in self.pool._strings],
+                                   dtype=np.int64)
+                if lengths.shape[0] == 0:
+                    return Column("int", jnp.zeros(self.capacity, jnp.int64),
+                                  c.valid, CTInteger)
+                table = jnp.asarray(lengths)
+                return Column(
+                    "int", table[jnp.clip(c.data, 0, table.shape[0] - 1)],
+                    c.valid, CTInteger)
+            raise UnsupportedOnDevice(f"size() on kind {c.kind}")
+        if name in ("e", "pi"):
+            import math
+            return self._literal(math.e if name == "e" else math.pi)
+        raise UnsupportedOnDevice(f"function {name}() has no device path")

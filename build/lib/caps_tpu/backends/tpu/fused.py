@@ -1,0 +1,166 @@
+"""Whole-query fused execution: record/replay of data-dependent sizes.
+
+The eager DeviceTable path must sync one scalar to the host per
+data-dependent output size (filter count, join total, explode total,
+group count — see kernels.py's two-phase pattern).  On a remote-device
+transport each sync is a full round trip, and a 2-hop query does ~10 of
+them; they dominate steady-state latency.  This module is the engine's
+analog of whole-stage codegen (the reference delegated the same problem
+to Spark's Tungsten pipeline — ref: spark-cypher/.../impl/table/
+SparkTable.scala, reconstructed, mount empty; SURVEY.md §3.1 invariant
+"one compiled program per plan"):
+
+* the FIRST execution of a (graph, query, params) key runs in ``record``
+  mode — it behaves exactly like the eager path but appends every size it
+  materializes to a memo;
+* every LATER execution runs in ``replay`` mode — ``consume_count`` serves
+  the memoized sizes with ZERO host syncs, so the whole query dispatches
+  as an uninterrupted async stream of compile-cached XLA programs and the
+  only sync left is the final result materialization.
+
+Replay is sound because sizes are a pure function of (graph data, query,
+parameters): graphs are immutable once created and the key includes the
+query text and parameter values.  If the op sequence nevertheless
+diverges (e.g. the session string pool crossed a kernel-eligibility
+threshold between record and replay and the plan took a different
+branch), ``consume_count`` or the end-of-run audit raises
+:class:`FusedReplayMismatch` and :meth:`FusedExecutor.run` transparently
+re-executes the query in record mode.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+from caps_tpu.backends.tpu.table import DeviceBackend, FusedReplayMismatch
+
+_graph_epochs = itertools.count()
+
+
+def _graph_key(graph) -> Optional[int]:
+    """A stable identity for a graph object.  Graphs are immutable, so an
+    epoch stamped on first use is a sound memo key (``id()`` alone is not —
+    it can be reused after gc)."""
+    k = getattr(graph, "_fused_epoch", None)
+    if k is None:
+        k = next(_graph_epochs)
+        try:
+            graph._fused_epoch = k
+        except Exception:
+            return None
+    return k
+
+
+def _reprable(v: Any) -> bool:
+    """True if ``repr(v)`` identifies the value's *content*.  Objects with
+    the default ``object.__repr__`` embed a memory address, which can be
+    reused after gc — a false memo hit there would replay sizes recorded
+    for different data, so such params refuse fusion instead."""
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return all(_reprable(x) for x in v)
+    if isinstance(v, dict):
+        return all(_reprable(k) and _reprable(x) for k, x in v.items())
+    return type(v).__repr__ is not object.__repr__
+
+
+def _params_key(params: Mapping[str, Any]) -> Optional[str]:
+    try:
+        if not all(_reprable(v) for v in params.values()):
+            return None
+        return repr(sorted(params.items()))
+    except Exception:
+        return None  # unorderable/unhashable params: skip fusion
+
+
+class FusedExecutor:
+    """Per-session memo of recorded size streams, keyed by
+    (graph epoch, query text, canonical params)."""
+
+    def __init__(self, backend: DeviceBackend, max_entries: int = 512):
+        self.backend = backend
+        self.max_entries = max_entries
+        # key -> (pool size at end of the record run, recorded sizes)
+        self._memo: Dict[Tuple, Tuple[int, List[int]]] = {}
+        self.recordings = 0
+        self.replays = 0
+        self.mismatches = 0
+
+    def key(self, graph, query: str,
+            params: Mapping[str, Any]) -> Optional[Tuple]:
+        gk = _graph_key(graph)
+        pk = _params_key(params)
+        if gk is None or pk is None:
+            return None
+        return (gk, query, pk)
+
+    def _replayable(self, key: Optional[Tuple]) -> bool:
+        """A recording is replayable only if the session string pool has
+        not grown since it was made: kernel-eligibility branches (e.g. the
+        dense Pallas group-by domain check) read the pool size, so a grown
+        pool could legally change the op sequence.  A changed pool is a
+        clean memo miss (re-record), not a replay hazard."""
+        entry = self._memo.get(key)
+        return entry is not None and entry[0] == len(self.backend.pool)
+
+    def run(self, key: Optional[Tuple], thunk: Callable[[], Any]) -> Any:
+        state: Dict[str, Optional[str]] = {"mode": None}
+        try:
+            with self._activate(key, state):
+                return thunk()
+        except Exception:
+            if state["mode"] != "replay":
+                # ambient/record-mode failures are genuine errors; a retry
+                # under an active outer recording would double-append its
+                # sizes and corrupt the outer memo.
+                raise
+            # ANY failure during replay is treated as divergence: drop the
+            # recording and re-execute in record mode (sizes served from a
+            # stale memo can surface as shape/index errors far from here).
+            self.mismatches += 1
+            self._memo.pop(key, None)
+            with self._activate(key, {"mode": None}):  # entry gone → record
+                return thunk()
+
+    @contextlib.contextmanager
+    def _activate(self, key: Optional[Tuple],
+                  state: Optional[Dict[str, Optional[str]]] = None):
+        if state is None:
+            state = {"mode": None}
+        backend = self.backend
+        # No key, or already inside an outer fused run (nested
+        # _cypher_on_graph for FROM GRAPH / CONSTRUCT): run under the
+        # ambient mode.
+        if key is None or backend.count_mode is not None:
+            yield
+            return
+        if not self._replayable(key):
+            state["mode"] = "record"
+            rec: List[int] = []
+            backend.count_mode = ("record", rec)
+            try:
+                yield
+            finally:
+                backend.count_mode = None
+            self._memo.pop(key, None)
+            while self._memo and len(self._memo) >= max(1, self.max_entries):
+                self._memo.pop(next(iter(self._memo)))
+            # Stamp the POST-run pool size: the record run may itself have
+            # interned new strings, after which the pool is stable for
+            # repeats of this exact query.
+            self._memo[key] = (len(backend.pool), rec)
+            self.recordings += 1
+        else:
+            state["mode"] = "replay"
+            sizes = self._memo[key][1]
+            cursor = [0]
+            backend.count_mode = ("replay", sizes, cursor)
+            try:
+                yield
+            finally:
+                backend.count_mode = None
+            if cursor[0] != len(sizes):
+                raise FusedReplayMismatch(
+                    f"replay consumed {cursor[0]} of {len(sizes)} recorded "
+                    f"sizes — op sequence diverged from the recording")
+            self.replays += 1

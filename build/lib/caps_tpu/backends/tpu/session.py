@@ -1,0 +1,71 @@
+"""TPUCypherSession — the user-facing session for the TPU backend.
+
+Mirrors the reference's ``CAPSSession``/``CAPSSessionImpl`` (ref:
+spark-cypher/.../api/CAPSSession.scala — reconstructed, mount empty;
+SURVEY.md §2): the planning stack is untouched; only the Table factory is
+device-backed.  Exposes the backend's fallback counter so benchmarks can
+assert the hot path stayed on-device.
+"""
+from __future__ import annotations
+
+from caps_tpu.backends.tpu.table import DeviceBackend, DeviceTableFactory
+from caps_tpu.okapi.config import DEFAULT_CONFIG
+from caps_tpu.relational.session import RelationalCypherSession
+
+
+class TPUCypherSession(RelationalCypherSession):
+    # planner gate for the SpMV count pushdown (relational/count_pattern.py);
+    # the local oracle stays on the join path so parity tests remain
+    # independent
+    supports_count_pushdown = True
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        self.backend = DeviceBackend(self.config)
+        self._factory = DeviceTableFactory(self.backend)
+        from caps_tpu.backends.tpu.fused import FusedExecutor
+        self.fused = FusedExecutor(self.backend,
+                                   max_entries=self.config.compile_cache_size)
+
+    @property
+    def table_factory(self) -> DeviceTableFactory:
+        return self._factory
+
+    def _cypher_on_graph(self, graph, query, parameters=None):
+        """Route every query through the fused executor: first run records
+        the data-dependent sizes, repeats replay them with zero host syncs
+        (backends/tpu/fused.py — the whole-stage-codegen analog)."""
+        if not self.config.use_fused:
+            return super()._cypher_on_graph(graph, query, parameters)
+        key = self.fused.key(graph, query, dict(parameters or {}))
+        return self.fused.run(
+            key, lambda: super(TPUCypherSession, self)._cypher_on_graph(
+                graph, query, parameters))
+
+    @property
+    def fallback_count(self) -> int:
+        return self.backend.fallbacks
+
+    def health_check(self) -> dict:
+        """Device health probe (SURVEY.md §5.3): run a tiny canary program
+        on every device of the session's mesh (or the default device) and
+        verify the arithmetic.  Returns {device_str: bool}.  A failed or
+        crashing device reports False rather than raising, so callers can
+        shrink the mesh and re-shard."""
+        import jax
+        import jax.numpy as jnp
+        devices = (list(self.backend.mesh.devices.flat)
+                   if self.backend.mesh is not None else [jax.devices()[0]])
+        status = {}
+        for d in devices:
+            try:
+                x = jax.device_put(jnp.arange(8, dtype=jnp.int32), d)
+                ok = int((x * 2 + 1).sum()) == 64
+            except Exception:
+                ok = False
+            status[str(d)] = ok
+        return status
+
+    @staticmethod
+    def local(**kwargs) -> "TPUCypherSession":
+        return TPUCypherSession(**kwargs)

@@ -1,0 +1,955 @@
+"""DeviceTable: the Table SPI over bucketed device columns.
+
+The TPU counterpart of the reference's ``SparkTable.DataFrameTable`` (ref:
+spark-cypher/.../impl/table/SparkTable.scala — reconstructed, mount empty;
+SURVEY.md §2): filter = mask + compact, join = sort-merge + segmented
+expansion, aggregate = sort + segment reductions, orderBy = multi-key
+lexicographic lax.sort — all shape-static and jit-cached per bucket.
+
+Collect aggregation runs on-device (sorted segment gather); the remaining
+operators without a device path (DISTINCT aggregates, some
+collection-valued expressions, …) raise :class:`UnsupportedOnDevice`; the
+table then converts to the local oracle backend and continues there.
+Fallbacks are counted on the backend object so benchmarks can assert the
+hot path stayed on-device.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from caps_tpu import ops as OPS
+from caps_tpu.backends.local.table import LocalTable, LocalTableFactory
+from caps_tpu.backends.tpu import kernels as K
+from caps_tpu.backends.tpu.column import (
+    Column, column_to_host, kind_for, literal_column, make_column,
+)
+from caps_tpu.backends.tpu.expr import DeviceExprCompiler, UnsupportedOnDevice
+from caps_tpu.backends.tpu.pool import make_pool
+from caps_tpu.ir.exprs import Expr
+from caps_tpu.okapi.config import EngineConfig
+from caps_tpu.okapi.types import CTBoolean, CTInteger, CypherType
+from caps_tpu.relational.header import RecordHeader
+from caps_tpu.relational.table import AggSpec, Table, TableFactory
+
+
+class DeviceBackend:
+    """Shared per-session state: string pool, config, mesh, fallback counter.
+
+    Distribution model (SURVEY.md §7 step 7): with a mesh configured,
+    columns are row-sharded over the mesh axis via ``NamedSharding`` and
+    every jitted operator runs SPMD — XLA's partitioner inserts the
+    collectives (all_gather for sort/probe, all_to_all for repartition),
+    the scaling-book recipe.  Hand-written shard_map paths (the pushdown
+    query step, the sharded Pallas aggregation) override it where we can
+    schedule ICI traffic better than the partitioner.
+    """
+
+    def __init__(self, config: EngineConfig):
+        self.pool = make_pool()
+        self.config = config
+        self.fallbacks = 0
+        self.fallback_reasons: List[str] = []
+        self.syncs = 0  # device->host scalar materializations (perf metric)
+        # Size-sync routing for the fused executor (backends/tpu/fused.py):
+        # None = eager (device->host sync per data-dependent size);
+        # ("record", sizes)       = eager + record every size in order;
+        # ("replay", sizes, [i])  = serve sizes from the memo, NO syncs —
+        # the whole query stays async / traceable.
+        self.count_mode: Optional[tuple] = None
+        self.mesh = None
+        self.axis = config.mesh_axis
+        if config.mesh_shape:
+            from caps_tpu.parallel.mesh import make_mesh
+            self.mesh = make_mesh(math.prod(config.mesh_shape),
+                                  axis=self.axis)
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.devices.size) if self.mesh is not None else 1
+
+    def place_rows(self, arr: jnp.ndarray) -> jnp.ndarray:
+        """Row-shard an array over the mesh (no-op single-chip or when the
+        row count doesn't divide)."""
+        if (self.mesh is None or arr.ndim == 0
+                or arr.shape[0] % self.n_shards):
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = (self.axis,) + (None,) * (arr.ndim - 1)
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+
+    def place_column(self, col: Column) -> Column:
+        if self.mesh is None:
+            return col
+        return Column(col.kind, self.place_rows(col.data),
+                      self.place_rows(col.valid), col.ctype,
+                      self.place_rows(col.lens) if col.lens is not None
+                      else None)
+
+    def bucket(self, n: int) -> int:
+        return max(1, self.config.bucket_for(n))
+
+    def consume_count(self, dev_scalar) -> int:
+        """Materialize a data-dependent size (see ``count_mode``)."""
+        mode = self.count_mode
+        if mode is None:
+            self.syncs += 1
+            return int(dev_scalar)
+        if mode[0] == "record":
+            self.syncs += 1
+            v = int(dev_scalar)
+            mode[1].append(v)
+            return v
+        sizes, cursor = mode[1], mode[2]
+        if cursor[0] >= len(sizes):
+            raise FusedReplayMismatch(
+                f"replay consumed {cursor[0]} sizes but the recording only "
+                f"has {len(sizes)}")
+        v = sizes[cursor[0]]
+        cursor[0] += 1
+        return v
+
+
+class FusedReplayMismatch(RuntimeError):
+    """The op sequence during fused replay diverged from the recording."""
+
+
+class DeviceTable(Table):
+    def __init__(self, backend: DeviceBackend,
+                 columns: Optional[Dict[str, Column]] = None, n: int = 0,
+                 local: Optional[LocalTable] = None):
+        self.backend = backend
+        self._cols: Dict[str, Column] = dict(columns or {})
+        self._n = n
+        self._local = local  # non-None → host-fallback mode
+
+    # -- mode handling -------------------------------------------------
+
+    @property
+    def is_local(self) -> bool:
+        return self._local is not None
+
+    def to_local(self) -> LocalTable:
+        if self._local is not None:
+            return self._local
+        data = {c: column_to_host(col, self._n, self.backend.pool)
+                for c, col in self._cols.items()}
+        types = {c: col.ctype for c, col in self._cols.items()}
+        return LocalTable(tuple(self._cols.keys()), data, types,
+                          size=self._n)
+
+    def _fallback(self, reason: str) -> "DeviceTable":
+        self.backend.fallbacks += 1
+        self.backend.fallback_reasons.append(reason)
+        return DeviceTable(self.backend, local=self.to_local())
+
+    def _wrap_local(self, local: LocalTable) -> "DeviceTable":
+        return DeviceTable(self.backend, local=local)
+
+    def _coerce_local(self, other: Table) -> LocalTable:
+        if isinstance(other, DeviceTable):
+            return other.to_local()
+        assert isinstance(other, LocalTable)
+        return other
+
+    @property
+    def capacity(self) -> int:
+        if self._cols:
+            return next(iter(self._cols.values())).capacity
+        return self.backend.bucket(self._n)
+
+    @property
+    def row_ok(self) -> jnp.ndarray:
+        return K.row_mask(self.capacity, self._n)
+
+    # -- shape ----------------------------------------------------------
+
+    @property
+    def columns(self) -> Tuple[str, ...]:
+        if self._local is not None:
+            return self._local.columns
+        return tuple(self._cols.keys())
+
+    @property
+    def size(self) -> int:
+        if self._local is not None:
+            return self._local.size
+        return self._n
+
+    def column_type(self, col: str) -> CypherType:
+        if self._local is not None:
+            return self._local.column_type(col)
+        return self._cols[col].ctype
+
+    # -- column ops ------------------------------------------------------
+
+    def select(self, cols: Sequence[str]) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.select(cols))
+        missing = [c for c in cols if c not in self._cols]
+        if missing:
+            raise KeyError(f"missing columns {missing}; have {self.columns}")
+        return DeviceTable(self.backend, {c: self._cols[c] for c in cols},
+                           self._n)
+
+    def rename(self, mapping: Mapping[str, str]) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.rename(mapping))
+        out = {mapping.get(c, c): col for c, col in self._cols.items()}
+        if len(out) != len(self._cols):
+            raise ValueError(f"rename collision: {mapping}")
+        return DeviceTable(self.backend, out, self._n)
+
+    def copy_column(self, src: str, dst: str) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.copy_column(src, dst))
+        out = dict(self._cols)
+        out[dst] = self._cols[src]
+        return DeviceTable(self.backend, out, self._n)
+
+    def with_literal_column(self, name, value, ctype) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(
+                self._local.with_literal_column(name, value, ctype))
+        try:
+            col = self.backend.place_column(
+                literal_column(value, ctype, self.capacity,
+                               self.backend.pool))
+        except ValueError as ex:
+            return self._fallback(str(ex)).with_literal_column(
+                name, value, ctype)
+        out = dict(self._cols)
+        out[name] = col
+        return DeviceTable(self.backend, out, self._n)
+
+    def with_row_index(self, name: str) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.with_row_index(name))
+        col = self.backend.place_column(
+            Column("int", jnp.arange(self.capacity, dtype=jnp.int64),
+                   jnp.ones(self.capacity, bool), CTInteger))
+        out = dict(self._cols)
+        out[name] = col
+        return DeviceTable(self.backend, out, self._n)
+
+    def with_column(self, name, expr: Expr, header: RecordHeader,
+                    parameters, ctype) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.with_column(
+                name, expr, header, parameters, ctype))
+        try:
+            compiler = DeviceExprCompiler(self._cols, self.capacity, header,
+                                          parameters, self.backend.pool,
+                                          self.row_ok)
+            col = compiler.compile(expr)
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).with_column(
+                name, expr, header, parameters, ctype)
+        out = dict(self._cols)
+        out[name] = col
+        return DeviceTable(self.backend, out, self._n)
+
+    # -- row ops ---------------------------------------------------------
+
+    def filter(self, expr: Expr, header: RecordHeader,
+               parameters) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.filter(expr, header, parameters))
+        try:
+            compiler = DeviceExprCompiler(self._cols, self.capacity, header,
+                                          parameters, self.backend.pool,
+                                          self.row_ok)
+            pred = compiler.compile(expr)
+            if pred.kind != "bool":
+                raise UnsupportedOnDevice("filter predicate is not boolean")
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).filter(expr, header, parameters)
+        mask = pred.data & pred.valid & self.row_ok
+        return self._compact(mask)
+
+    def _compact(self, mask: jnp.ndarray) -> "DeviceTable":
+        new_n = self.backend.consume_count(K.mask_count(mask))
+        out_cap = self.backend.bucket(new_n)
+        idx, _ = K.compact_indices(mask, out_cap)
+        idx = self.backend.place_rows(idx)
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+
+    def join(self, other: Table, how: str,
+             pairs: Sequence[Tuple[str, str]]) -> "DeviceTable":
+        if self._local is not None or (isinstance(other, DeviceTable)
+                                       and other.is_local):
+            return self._wrap_local(self.to_local().join(
+                self._coerce_local(other), how, pairs))
+        assert isinstance(other, DeviceTable)
+        shared = set(self.columns) & set(other.columns)
+        if shared:
+            raise ValueError(f"join column collision: {shared}")
+        try:
+            if how == "cross":
+                return self._cross_join(other)
+            return self._sort_merge_join(other, how, pairs)
+        except UnsupportedOnDevice as ex:
+            return self._wrap_local(self.to_local().join(
+                other.to_local(), how, pairs))
+
+    def _join_key(self, col: Column, side: str = "l") -> jnp.ndarray:
+        if col.kind in ("id", "int", "str", "bool"):
+            return col.data.astype(jnp.int64)
+        if col.kind == "float":
+            # Monotone float64 -> int64 bit transform: order-preserving, so
+            # the sort/search machinery works unchanged.  -0.0 is folded
+            # into +0.0 first (they must join), and NaN maps to a per-side
+            # sentinel so NaN never matches anything (incl. other NaNs).
+            x = jnp.where(col.data == 0.0, 0.0, col.data)
+            bits = x.view(jnp.int64)
+            key = jnp.where(bits < 0, jnp.int64(-(2**63)) - bits, bits)
+            nan_sent = K._L_NAN if side == "l" else K._R_NAN
+            return jnp.where(jnp.isnan(col.data), nan_sent, key)
+        raise UnsupportedOnDevice(f"join key of kind {col.kind}")
+
+    def _cached_right_sort(self, other: "DeviceTable", rcol: Column):
+        """Sort of the build side, memoized on the column object: static
+        scan tables (the relationship table every Expand hop probes) are
+        sorted once per graph, not once per hop."""
+        key = (other._n,)
+        cached = getattr(rcol, "_join_sort", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        r_ok = rcol.valid & other.row_ok
+        res = K.sort_right(self._join_key(rcol, side="r"), r_ok)
+        rcol._join_sort = (key, res)
+        return res
+
+    def _csr_for(self, other: "DeviceTable", rcol: Column):
+        """The HBM-resident CSR for a build-side column, if the ingest
+        hook (DeviceTableFactory.prepare_rel_table) attached one and the
+        table still has the shape it was built for."""
+        if not self.backend.config.use_csr:
+            return None
+        cached = getattr(rcol, "_csr", None)
+        if cached is not None and cached[0] == (other._n,):
+            return cached[1]
+        return None
+
+    def _sort_merge_join(self, other: "DeviceTable", how: str,
+                         pairs: Sequence[Tuple[str, str]]) -> "DeviceTable":
+        lc, rc = pairs[0]
+        lcol, rcol = self._cols[lc], other._cols[rc]
+        l_ok = lcol.valid & self.row_ok
+        left_join = how == "left"
+        csr = self._csr_for(other, rcol)
+        if csr is not None:
+            # CSR probe: two indptr gathers per row, no sort, no search
+            counts, lo = csr.probe(self._join_key(lcol), l_ok)
+            perm = csr.perm
+        else:
+            rk_sorted, perm = self._cached_right_sort(other, rcol)
+            counts, lo = K.probe_count(self._join_key(lcol), l_ok, rk_sorted)
+        total = self.backend.consume_count(K.join_total(counts, l_ok, left_join))
+        out_cap = self.backend.bucket(total)
+        if self.backend.config.use_pallas:
+            l_idx, r_idx, out_valid, r_matched = OPS.join_expand_via_positions(
+                counts, lo, perm, l_ok, out_cap, left_join,
+                interpret=OPS.default_interpret())
+        else:
+            l_idx, r_idx, out_valid, r_matched, _ = K.join_expand(
+                counts, lo, perm, l_ok, out_cap, left_join)
+        l_idx = self.backend.place_rows(l_idx)
+        r_idx = self.backend.place_rows(r_idx)
+        out_cols = _gather_cols(self._cols, l_idx)
+        right = _gather_cols(other._cols, r_idx)
+        for c, col in right.items():
+            out_cols[c] = Column(col.kind, col.data, col.valid & r_matched,
+                                 col.ctype, col.lens)
+        out = DeviceTable(self.backend, out_cols, total)
+        # Extra equality pairs: post-filter (first pair drove the merge).
+        for lc2, rc2 in pairs[1:]:
+            a, b = out._cols[lc2], out._cols[rc2]
+            if a.kind == "float" or b.kind == "float":
+                # NaN == NaN is False here, matching join semantics
+                eq = (a.data.astype(jnp.float64)
+                      == b.data.astype(jnp.float64)) & a.valid & b.valid
+            else:
+                eq = (a.data.astype(jnp.int64) == b.data.astype(jnp.int64)) \
+                    & a.valid & b.valid
+            if left_join:
+                # unmatched left rows keep their single null-extended row
+                keep = eq | ~out._cols[rc2].valid
+            else:
+                keep = eq
+            out = out._compact(keep & out.row_ok)
+        return out
+
+    def _cross_join(self, other: "DeviceTable") -> "DeviceTable":
+        total = self._n * other._n
+        out_cap = self.backend.bucket(total)
+        counts = jnp.where(self.row_ok, other._n, 0)
+        offsets = jnp.cumsum(counts)
+        t = jnp.arange(out_cap)
+        l_idx = jnp.clip(jnp.searchsorted(offsets, t, side="right"),
+                         0, max(0, self.capacity - 1))
+        seg_start = jnp.where(l_idx > 0, offsets[l_idx - 1], 0)
+        within = (t - seg_start) % max(1, other.capacity)
+        out_cols = _gather_cols(self._cols, l_idx)
+        out_cols.update(_gather_cols(other._cols, within))
+        return DeviceTable(self.backend, out_cols, total)
+
+    def union_all(self, other: Table) -> "DeviceTable":
+        if self._local is not None or (isinstance(other, DeviceTable)
+                                       and other.is_local):
+            return self._wrap_local(self.to_local().union_all(
+                self._coerce_local(other)))
+        assert isinstance(other, DeviceTable)
+        if set(self.columns) != set(other.columns):
+            raise ValueError(f"union column mismatch: {self.columns} vs "
+                             f"{other.columns}")
+        total = self._n + other._n
+        out_cap = self.backend.bucket(total)
+        out: Dict[str, Column] = {}
+        for c in self.columns:
+            a, b = self._cols[c], other._cols[c]
+            if a.kind != b.kind:
+                numeric = {"id", "int", "float"}
+                if a.kind in numeric and b.kind in numeric:
+                    target = "float" if "float" in (a.kind, b.kind) else "int"
+                    a, b = a.astype_kind(target), b.astype_kind(target)
+                else:
+                    return self._fallback(
+                        f"union kind mismatch {a.kind}/{b.kind}").union_all(other)
+            out[c] = _concat_columns(a, self._n, b, other._n, out_cap,
+                                     a.ctype.join(b.ctype))
+        return DeviceTable(self.backend, out, total)
+
+    def distinct(self) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.distinct())
+        try:
+            keys = [(~self.row_ok).astype(jnp.int64)]
+            for col in self._cols.values():
+                keys.extend(_sort_keys(col, ascending=True,
+                                       nulls_last=True, pool=self.backend.pool))
+            perm = K.sort_perm(keys, self.capacity)
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).distinct()
+        sorted_cols = _gather_cols(self._cols, perm)
+        change = K.neighbor_change_keys([k[perm] for k in keys])
+        keep = change & K.row_mask(self.capacity, self._n)
+        tmp = DeviceTable(self.backend, sorted_cols, self._n)
+        return tmp._compact(keep)
+
+    def order_by(self, items: Sequence[Tuple[str, bool]]) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.order_by(items))
+        try:
+            keys = [(~self.row_ok).astype(jnp.int64)]
+            for col_name, asc in items:
+                col = self._cols[col_name]
+                keys.extend(_sort_keys(col, ascending=asc, nulls_last=asc,
+                                       pool=self.backend.pool))
+            perm = K.sort_perm(keys, self.capacity)
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).order_by(items)
+        return DeviceTable(self.backend, _gather_cols(self._cols, perm),
+                           self._n)
+
+    def skip(self, n: int) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.skip(n))
+        n = max(0, n)
+        new_n = max(0, self._n - n)
+        out_cap = self.backend.bucket(new_n)
+        idx = jnp.arange(out_cap) + n
+        idx = jnp.clip(idx, 0, max(0, self.capacity - 1))
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+
+    def limit(self, n: int) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.limit(n))
+        new_n = min(max(0, n), self._n)
+        out_cap = self.backend.bucket(new_n)
+        idx = jnp.clip(jnp.arange(out_cap), 0, max(0, self.capacity - 1))
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+
+    # -- aggregation ------------------------------------------------------
+
+    def group(self, by: Sequence[str], aggs: Sequence[AggSpec]) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.group(by, aggs))
+        try:
+            return self._group_device(by, aggs)
+        except UnsupportedOnDevice as ex:
+            return self._fallback(str(ex)).group(by, aggs)
+
+    def _group_device(self, by: Sequence[str],
+                      aggs: Sequence[AggSpec]) -> "DeviceTable":
+        for a in aggs:
+            if a.kind in ("percentile_cont", "percentile_disc"):
+                raise UnsupportedOnDevice(f"{a.kind} aggregation")
+        fast = self._group_dense_pallas(by, aggs)
+        if fast is not None:
+            return fast
+        cap = self.capacity
+        pool = self.backend.pool
+        if by:
+            keys = [(~self.row_ok).astype(jnp.int64)]
+            for c in by:
+                keys.extend(_sort_keys(self._cols[c], True, True, pool))
+            perm = K.sort_perm(keys, cap)
+            sorted_cols = _gather_cols(self._cols, perm)
+            change = K.neighbor_change_keys(
+                [k[perm] for k in keys[1:]]) & K.row_mask(cap, self._n)
+            seg_id = jnp.clip(jnp.cumsum(change.astype(jnp.int32)) - 1, 0, None)
+            n_groups = self.backend.consume_count(K.mask_count(change))
+        else:
+            sorted_cols = dict(self._cols)
+            seg_id = jnp.zeros(cap, jnp.int32)
+            n_groups = 1
+            change = jnp.zeros(cap, bool).at[0].set(True) \
+                if cap > 0 else jnp.zeros(cap, bool)
+        out_cap = self.backend.bucket(n_groups)
+        row_ok_sorted = K.row_mask(cap, self._n)
+        if by:
+            start_idx, _ = K.compact_indices(change, out_cap)
+        else:
+            start_idx = jnp.zeros(out_cap, jnp.int32)
+
+        out: Dict[str, Column] = {}
+        for c in by:
+            col = sorted_cols[c]
+            g = Column(col.kind, col.data[start_idx], col.valid[start_idx],
+                       col.ctype, col.lens[start_idx] if col.lens is not None
+                       else None)
+            out[c] = g
+        num_segments = out_cap
+
+        # DISTINCT aggregation: one extra stable sort per distinct column
+        # marks the FIRST occurrence of each (group, value); the agg then
+        # runs with that mask ANDed in (oracle semantics: dedupe keeps the
+        # first occurrence, so collect order matches too).
+        group_keys_sorted = [k[perm] for k in keys] if by else []
+        firstocc_cache: Dict[str, jnp.ndarray] = {}
+
+        def firstocc_for(col_name: str) -> jnp.ndarray:
+            if col_name not in firstocc_cache:
+                col = sorted_cols[col_name]
+                vk = _sort_keys(col, True, True, pool)
+                combined = group_keys_sorted + vk
+                p2 = K.sort_perm(combined, cap)
+                ch2 = K.neighbor_change_keys([k[p2] for k in combined])
+                firstocc_cache[col_name] = \
+                    jnp.zeros(cap, bool).at[p2].set(ch2)
+            return firstocc_cache[col_name]
+
+        for a in aggs:
+            extra = firstocc_for(a.col) if a.distinct else None
+            out[a.name] = self._one_agg(a, sorted_cols, seg_id, num_segments,
+                                        row_ok_sorted, n_groups,
+                                        firstocc=extra, start_idx=start_idx)
+        return DeviceTable(self.backend, out, n_groups)
+
+    def _group_dense_pallas(self, by: Sequence[str],
+                            aggs: Sequence[AggSpec]
+                            ) -> Optional["DeviceTable"]:
+        """Sort-free group-by over a dictionary-coded key: the string pool
+        makes group keys a *dense* int domain, so grouping is a Pallas
+        histogram (caps_tpu/ops/segment.py) — no lax.sort, no scatter.
+        Returns None when the shape doesn't fit (engine falls back to the
+        sorted path)."""
+        cfg = self.backend.config
+        if not cfg.use_pallas or len(by) != 1:
+            return None
+        if any(a.distinct or a.kind == "collect" for a in aggs):
+            return None  # sorted path handles distinct/collect
+        key_col = self._cols.get(by[0])
+        if key_col is None or key_col.kind not in ("str", "bool"):
+            return None
+        domain = len(self.backend.pool) if key_col.kind == "str" else 2
+        S = domain + 1  # one slot for the null-key group
+        if S > 4096 or S > self.capacity * 64:
+            return None
+        for a in aggs:
+            if a.kind not in ("count_star", "count", "min", "max"):
+                return None
+            if a.kind in ("min", "max"):
+                c = self._cols.get(a.col)
+                if c is None or c.kind not in ("int", "id"):
+                    return None
+        row_ok = self.row_ok
+        # int64 min/max ride the i32 kernel only when the values fit
+        for c in {a.col for a in aggs if a.kind in ("min", "max")}:
+            col = self._cols[c]
+            if col.kind == "int":
+                ok = col.valid & row_ok
+                lo = self.backend.consume_count(jnp.min(jnp.where(ok, col.data, 0)))
+                hi = self.backend.consume_count(jnp.max(jnp.where(ok, col.data, 0)))
+                if not (-2**31 < lo and hi < 2**31):
+                    return None
+
+        interp = OPS.default_interpret()
+        backend = self.backend
+        sharded = (backend.mesh is not None
+                   and self.capacity % backend.n_shards == 0)
+
+        def agg_kernel(codes_, ok_, vals_, kind_):
+            if sharded:
+                return OPS.dense_segment_agg_sharded(
+                    backend.mesh, backend.axis, codes_, ok_, vals_, S, kind_,
+                    interpret=interp)
+            return OPS.dense_segment_agg(codes_, ok_, vals_, S, kind_,
+                                         interpret=interp)
+
+        codes = jnp.where(key_col.valid & row_ok,
+                          key_col.data.astype(jnp.int32), domain)
+        counts_all = agg_kernel(codes, row_ok, codes, "count")
+        count_cache: Dict[str, jnp.ndarray] = {}
+
+        def count_of(col_name: str) -> jnp.ndarray:
+            if col_name not in count_cache:
+                col = self._cols[col_name]
+                count_cache[col_name] = agg_kernel(
+                    codes, col.valid & row_ok, codes, "count")
+            return count_cache[col_name]
+
+        out: Dict[str, Column] = {}
+        live = jnp.ones(S, bool)
+        if key_col.kind == "str":
+            out[by[0]] = Column("str", jnp.arange(S, dtype=jnp.int32),
+                                jnp.arange(S) < domain, key_col.ctype)
+        else:
+            out[by[0]] = Column("bool", jnp.arange(S) == 1,
+                                jnp.arange(S) < domain, key_col.ctype)
+        for a in aggs:
+            if a.kind == "count_star":
+                out[a.name] = Column("int", counts_all.astype(jnp.int64),
+                                     live, CTInteger)
+            elif a.kind == "count":
+                out[a.name] = Column("int",
+                                     count_of(a.col).astype(jnp.int64),
+                                     live, CTInteger)
+            else:  # min / max over int/id
+                col = self._cols[a.col]
+                vals = col.data.astype(jnp.int32)
+                agg = agg_kernel(
+                    codes, col.valid & row_ok, vals,
+                    "min_i32" if a.kind == "min" else "max_i32")
+                has = count_of(a.col) > 0
+                out[a.name] = Column(col.kind, agg.astype(
+                    jnp.int64 if col.kind == "int" else jnp.int32),
+                    has, col.ctype)
+        dense = DeviceTable(self.backend, out, S)
+        return dense._compact(counts_all > 0)
+
+    def _one_agg(self, a: AggSpec, cols: Dict[str, Column], seg_id,
+                 num_segments: int, row_ok, n_groups: int,
+                 firstocc=None, start_idx=None) -> Column:
+        group_live = jnp.arange(num_segments) < n_groups
+        if a.kind == "count_star":
+            data = K.sorted_segment_agg(row_ok, row_ok, seg_id,
+                                        num_segments, "count")
+            return Column("int", data, group_live, CTInteger)
+        col = cols[a.col]
+        ok = col.valid & row_ok
+        if firstocc is not None:
+            ok = ok & firstocc
+        if a.kind == "count":
+            data = K.sorted_segment_agg(ok, ok, seg_id, num_segments, "count")
+            return Column("int", data, group_live, CTInteger)
+        if a.kind == "collect":
+            return self._collect_agg(a, col, ok, seg_id, num_segments,
+                                     group_live, start_idx)
+        if col.kind == "list":
+            raise UnsupportedOnDevice(f"{a.kind} over list column")
+        if a.kind == "first":
+            data, has = K.segment_agg(col.data, ok, seg_id, num_segments,
+                                      "first")
+            return Column(col.kind, data, has & group_live, col.ctype)
+        if col.kind == "str" and a.kind in ("min", "max"):
+            rank = jnp.asarray(self.backend.pool.rank_array())
+            if rank.shape[0] == 0:
+                return Column("str", jnp.zeros(num_segments, jnp.int32),
+                              jnp.zeros(num_segments, bool), col.ctype)
+            ranks = rank[jnp.clip(col.data, 0, rank.shape[0] - 1)]
+            agg = K.segment_agg(ranks.astype(jnp.int64), ok, seg_id,
+                                num_segments, a.kind)
+            counts = K.segment_agg(ranks, ok, seg_id, num_segments, "count")
+            inv = jnp.argsort(rank).astype(jnp.int32)
+            safe = jnp.clip(agg, 0, inv.shape[0] - 1).astype(jnp.int32)
+            return Column("str", inv[safe], (counts > 0) & group_live,
+                          col.ctype)
+        if col.kind not in ("int", "float", "id", "bool"):
+            raise UnsupportedOnDevice(f"{a.kind} over kind {col.kind}")
+        values = col.data
+        counts = K.segment_agg(values, ok, seg_id, num_segments, "count")
+        if a.kind == "sum":
+            if col.kind in ("int", "bool"):
+                data = K.sorted_segment_agg(values.astype(jnp.int64), ok,
+                                            seg_id, num_segments, "sum")
+            else:
+                data = K.segment_agg(values, ok, seg_id, num_segments, "sum")
+            return Column(col.kind if col.kind != "bool" else "int",
+                          data, group_live,
+                          a.result_type or col.ctype)
+        if a.kind in ("min", "max"):
+            data = K.segment_agg(values, ok, seg_id, num_segments, a.kind)
+            return Column(col.kind, data, (counts > 0) & group_live, col.ctype)
+        if a.kind == "avg":
+            s = K.segment_agg(values.astype(jnp.float64), ok, seg_id,
+                              num_segments, "sum")
+            data = s / jnp.maximum(counts, 1)
+            from caps_tpu.okapi.types import CTFloat
+            return Column("float", data, (counts > 0) & group_live, CTFloat)
+        if a.kind == "stdev":
+            v = values.astype(jnp.float64)
+            s = K.segment_agg(v, ok, seg_id, num_segments, "sum")
+            s2 = K.segment_agg(v * v, ok, seg_id, num_segments, "sum")
+            nn = jnp.maximum(counts, 1).astype(jnp.float64)
+            var = jnp.maximum(0.0, (s2 - s * s / nn) / jnp.maximum(nn - 1, 1))
+            data = jnp.sqrt(var)
+            data = jnp.where(counts > 1, data, 0.0)
+            from caps_tpu.okapi.types import CTFloat
+            return Column("float", data, (counts > 0) & group_live, CTFloat)
+        raise UnsupportedOnDevice(f"aggregation {a.kind}")
+
+    def _collect_agg(self, a: AggSpec, col: Column, ok, seg_id,
+                     num_segments: int, group_live, start_idx) -> Column:
+        """collect(x) on device: per-group value lists laid out as a
+        (groups, max_len) int32 matrix via one flat scatter.  Kept rows
+        are in group-sorted (stable) order, i.e. original row order within
+        each group — the oracle's collect order."""
+        from caps_tpu.backends.tpu.column import list_elem_kind
+        if col.kind not in ("id", "int", "str", "bool"):
+            raise UnsupportedOnDevice(f"collect over kind {col.kind}")
+        if a.result_type is None or list_elem_kind(a.result_type) is None:
+            raise UnsupportedOnDevice("collect to host-only list type")
+        if col.kind == "int":
+            lo = self.backend.consume_count(
+                jnp.min(jnp.where(ok, col.data, 0)))
+            hi = self.backend.consume_count(
+                jnp.max(jnp.where(ok, col.data, 0)))
+            if not (-2**31 < lo and hi < 2**31):
+                raise UnsupportedOnDevice("collect of int64-range values")
+        counts = K.segment_agg(col.data, ok, seg_id, num_segments, "count")
+        max_len = self.backend.consume_count(
+            jnp.max(counts) if num_segments else jnp.int64(0))
+        L = max(1, int(max_len))
+        # rank of each kept row within its segment
+        c = jnp.cumsum(ok.astype(jnp.int32))
+        sp = start_idx[jnp.clip(seg_id, 0, start_idx.shape[0] - 1)]
+        base = jnp.where(sp > 0, c[jnp.maximum(sp - 1, 0)], 0)
+        within = c - 1 - base
+        sentinel = num_segments * L
+        flat_idx = jnp.where(ok, seg_id * L + within, sentinel)
+        vals32 = (col.data != 0).astype(jnp.int32) if col.kind == "bool" \
+            else col.data.astype(jnp.int32)
+        flat = jnp.zeros(sentinel + 1, jnp.int32).at[flat_idx].set(vals32)
+        data = flat[:-1].reshape(num_segments, L)
+        return Column("list", data, group_live, a.result_type,
+                      counts.astype(jnp.int32))
+
+    # -- lists -----------------------------------------------------------
+
+    def explode(self, list_col: str, out_col: str,
+                out_type: CypherType) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.explode(list_col, out_col,
+                                                        out_type))
+        col = self._cols.get(list_col)
+        if col is None or col.kind != "list":
+            return self._fallback("explode of non-list column").explode(
+                list_col, out_col, out_type)
+        ok = col.valid & self.row_ok
+        total = self.backend.consume_count(jnp.where(ok, col.lens, 0).sum())
+        out_cap = self.backend.bucket(total)
+        row, within, out_valid, _ = K.explode_expand(col.lens, ok, out_cap)
+        rest = {c: v for c, v in self._cols.items() if c != list_col}
+        out_cols = _gather_cols(rest, row)
+        values = col.data[row, jnp.clip(within, 0, col.data.shape[1] - 1)]
+        out_kind = kind_for(out_type)
+        if out_kind == "object":
+            return self._fallback("explode to host-only element type"
+                                  ).explode(list_col, out_col, out_type)
+        from caps_tpu.backends.tpu.column import _DTYPES
+        if out_kind == "bool":
+            values = values != 0
+        else:
+            values = values.astype(_DTYPES[out_kind])
+        out_cols[out_col] = Column(out_kind, values, out_valid, out_type)
+        return DeviceTable(self.backend, out_cols, total)
+
+    def pack_list(self, cols: Sequence[str], out_col: str,
+                  out_type: CypherType) -> "DeviceTable":
+        if self._local is not None:
+            return self._wrap_local(self._local.pack_list(cols, out_col,
+                                                          out_type))
+        cap = self.capacity
+        if not cols:
+            data = jnp.zeros((cap, 1), jnp.int32)
+            lens = jnp.zeros(cap, jnp.int32)
+        else:
+            parts = []
+            valids = []
+            for c in cols:
+                col = self._cols[c]
+                if col.kind not in ("id", "int"):
+                    return self._fallback("pack_list of non-id column"
+                                          ).pack_list(cols, out_col, out_type)
+                parts.append(col.data.astype(jnp.int32))
+                valids.append(col.valid)
+            stacked = jnp.stack(parts, axis=1)          # (cap, k)
+            vstacked = jnp.stack(valids, axis=1)
+            # compact valid entries to the left per-row
+            order = jnp.argsort(~vstacked, axis=1, stable=True)
+            data = jnp.take_along_axis(stacked, order, axis=1)
+            lens = vstacked.sum(axis=1).astype(jnp.int32)
+        out = dict(self._cols)
+        out[out_col] = Column("list", data, jnp.ones(cap, bool), out_type,
+                              lens)
+        return DeviceTable(self.backend, out, self._n)
+
+    # -- materialization --------------------------------------------------
+
+    def column_values(self, col: str) -> List[Any]:
+        if self._local is not None:
+            return self._local.column_values(col)
+        return column_to_host(self._cols[col], self._n, self.backend.pool)
+
+
+@jax.jit
+def _gather_tree(arrays, idx):
+    """One fused dispatch for a whole-table gather: every per-column
+    row-gather rides a single XLA executable instead of 2-3 dispatches per
+    column (each dispatch is a round trip on remote-device transports)."""
+    return jax.tree_util.tree_map(lambda a: a[idx], arrays)
+
+
+def _gather_cols(cols: Dict[str, Column], idx: jnp.ndarray
+                 ) -> Dict[str, Column]:
+    arrays = {}
+    for c, col in cols.items():
+        arrays[c] = ((col.data, col.valid, col.lens) if col.kind == "list"
+                     else (col.data, col.valid))
+    gathered = _gather_tree(arrays, idx)
+    out = {}
+    for c, col in cols.items():
+        g = gathered[c]
+        if col.kind == "list":
+            out[c] = Column(col.kind, g[0], g[1], col.ctype, g[2])
+        else:
+            out[c] = Column(col.kind, g[0], g[1], col.ctype)
+    return out
+
+
+def _concat_columns(a: Column, n_a: int, b: Column, n_b: int, out_cap: int,
+                    ctype: CypherType) -> Column:
+    if a.kind == "list":
+        la = a.data.shape[1]
+        lb = b.data.shape[1]
+        width = max(la, lb)
+        da = jnp.pad(a.data[:n_a], ((0, 0), (0, width - la)))
+        db = jnp.pad(b.data[:n_b], ((0, 0), (0, width - lb)))
+        data = jnp.concatenate([da, db], axis=0)
+        data = jnp.pad(data, ((0, out_cap - n_a - n_b), (0, 0)))
+        lens = jnp.concatenate([a.lens[:n_a], b.lens[:n_b]])
+        lens = jnp.pad(lens, (0, out_cap - n_a - n_b))
+        valid = jnp.concatenate([a.valid[:n_a], b.valid[:n_b]])
+        valid = jnp.pad(valid, (0, out_cap - n_a - n_b))
+        return Column("list", data, valid, ctype, lens)
+    data = jnp.concatenate([a.data[:n_a], b.data[:n_b]])
+    data = jnp.pad(data, (0, out_cap - n_a - n_b))
+    valid = jnp.concatenate([a.valid[:n_a], b.valid[:n_b]])
+    valid = jnp.pad(valid, (0, out_cap - n_a - n_b))
+    return Column(a.kind, data, valid, ctype)
+
+
+def _sort_keys(col: Column, ascending: bool, nulls_last: bool,
+               pool) -> List[jnp.ndarray]:
+    """Transform one column into (null_key, data_key) int64/float64 arrays
+    for an ascending lexicographic sort."""
+    if col.kind == "list":
+        raise UnsupportedOnDevice("sorting by list column")
+    null_key = (~col.valid).astype(jnp.int64)
+    if not nulls_last:
+        null_key = -null_key
+    if col.kind == "str":
+        rank = jnp.asarray(pool.rank_array())
+        if rank.shape[0] == 0:
+            data = col.data.astype(jnp.int64)
+        else:
+            data = rank[jnp.clip(col.data, 0, rank.shape[0] - 1)].astype(jnp.int64)
+    elif col.kind == "bool":
+        data = col.data.astype(jnp.int64)
+    elif col.kind == "float":
+        data = col.data
+    else:
+        data = col.data.astype(jnp.int64)
+    if not ascending:
+        data = -data
+    # nulls must not influence the data key
+    data = jnp.where(col.valid, data, 0)
+    return [null_key, data]
+
+
+class DeviceTableFactory(TableFactory):
+    def __init__(self, backend: DeviceBackend):
+        self.backend = backend
+        self._local = LocalTableFactory()
+
+    def prepare_rel_table(self, rel_table) -> None:
+        """Ingest-time physical layout: build HBM-resident CSR adjacency
+        over the relationship table's source and target columns (C++
+        csr_build on the host when available, one numpy sort otherwise).
+        Every later Expand hop against this table probes ``indptr``
+        instead of sorting + binary-searching the edge list."""
+        if not self.backend.config.use_csr:
+            return
+        t = rel_table.table
+        if not isinstance(t, DeviceTable) or t.is_local:
+            return
+        m = rel_table.mapping
+        for name in (m.source_col, m.target_col):
+            col = t._cols.get(name)
+            if col is None or col.kind not in ("id", "int"):
+                continue
+            if getattr(col, "_csr", None) is not None:
+                continue
+            csr = OPS.build_csr(col.data, col.valid & t.row_ok, t._n)
+            col._csr = ((t._n,), csr)
+
+    def from_columns(self, data: Mapping[str, Sequence[Any]],
+                     types: Mapping[str, CypherType]) -> DeviceTable:
+        n = len(next(iter(data.values()))) if data else 0
+        cap = self.backend.bucket(n)
+        cols: Dict[str, Column] = {}
+        for c, values in data.items():
+            ctype = types[c]
+            if kind_for(ctype) == "object":
+                local = self._local.from_columns(data, types)
+                return DeviceTable(self.backend, local=local)
+            try:
+                col = make_column(list(values), ctype, cap, self.backend.pool)
+            except ValueError:
+                # values the device encoding rejects (int32-overflowing
+                # list elements, null-in-list, oversized ids): host table
+                local = self._local.from_columns(data, types)
+                return DeviceTable(self.backend, local=local)
+            cols[c] = self.backend.place_column(col)
+        return DeviceTable(self.backend, cols, n)
+
+    def unit(self) -> DeviceTable:
+        return DeviceTable(self.backend, {}, 1)
+
+    def empty(self, cols: Sequence[str],
+              types: Mapping[str, CypherType]) -> DeviceTable:
+        out: Dict[str, Column] = {}
+        cap = self.backend.bucket(0)
+        for c in cols:
+            ctype = types.get(c, CTInteger)
+            if kind_for(ctype) == "object":
+                local = self._local.empty(cols, types)
+                return DeviceTable(self.backend, local=local)
+            out[c] = make_column([], ctype, cap, self.backend.pool)
+        return DeviceTable(self.backend, out, 0)

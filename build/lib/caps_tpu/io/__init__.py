@@ -1,0 +1,8 @@
+"""Property graph data sources.
+
+The in-memory ``session`` source lives in :mod:`caps_tpu.okapi.catalog`
+(default namespace); this package holds durable sources — the filesystem
+source (Parquet/CSV directory convention + schema.json), mirroring the
+reference's fs PGDS family (SURVEY.md §2 "PGDS: filesystem").
+"""
+from caps_tpu.io.fs import FSGraphSource  # noqa: F401

@@ -1,0 +1,433 @@
+"""AST → IR: clause chains to query blocks.
+
+Mirrors the reference's ``IRBuilder`` — AST clauses → Blocks, patterns →
+``Pattern`` + ``Connection``s, expressions typed via ``SchemaTyper``,
+graph references resolved via the catalog (ref: okapi-ir/.../ir/impl/
+IRBuilder.scala — reconstructed, mount empty; SURVEY.md §2 "IR", §3.1).
+
+Normalizations performed here:
+  * inline pattern property maps → equality predicates;
+  * labels on already-bound vars → HasLabel predicates;
+  * undirected/incoming pattern hops → OUTGOING or BOTH connections
+    (incoming is flipped);
+  * aggregating projection items → AggregationBlock (+ post-ProjectBlock
+    when aggregators sit inside larger expressions);
+  * ORDER BY over pre-projection scope → hidden helper fields.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from caps_tpu.frontend import ast
+from caps_tpu.frontend.semantic import CypherSemanticError, check_statement
+from caps_tpu.ir import exprs as E
+from caps_tpu.ir.blocks import (
+    AggregationBlock, Block, ConstructBlock, CreateGraphStatement, CypherQuery,
+    CypherStatement, DropGraphStatement, FilterBlock, FromGraphBlock,
+    MatchBlock, OrderAndSliceBlock, ProjectBlock, ResultBlock, ReturnGraphBlock,
+    SelectBlock, UnionOfQueries, UnwindBlock,
+)
+from caps_tpu.ir.pattern import Connection, Direction, IRField, Pattern
+from caps_tpu.ir.typer import SchemaTyper
+from caps_tpu.okapi.graph import QualifiedGraphName
+from caps_tpu.okapi.schema import Schema
+from caps_tpu.okapi.types import (
+    CTAny, CTList, CTNode, CTRelationship, CypherType, _CTList,
+)
+
+SchemaResolver = Callable[[QualifiedGraphName], Schema]
+
+
+class IRBuildError(Exception):
+    pass
+
+
+_DIRECTION = {
+    ast.Direction.OUTGOING: Direction.OUTGOING,
+    ast.Direction.INCOMING: Direction.INCOMING,
+    ast.Direction.BOTH: Direction.BOTH,
+}
+
+
+class IRBuilder:
+    def __init__(self, ambient_schema: Schema,
+                 schema_resolver: Optional[SchemaResolver] = None,
+                 parameters: Optional[Mapping[str, object]] = None):
+        self.ambient_schema = ambient_schema
+        self.schema_resolver = schema_resolver
+        self.parameters = dict(parameters or {})
+
+    # -- entry --------------------------------------------------------------
+
+    def process(self, stmt: ast.Statement) -> CypherStatement:
+        check_statement(stmt)
+        if isinstance(stmt, ast.SingleQuery):
+            return self._build_single(stmt)
+        if isinstance(stmt, ast.UnionQuery):
+            return UnionOfQueries(
+                tuple(self._build_single(q) for q in stmt.queries),
+                union_all=stmt.union_all)
+        if isinstance(stmt, ast.CatalogCreateGraph):
+            return CreateGraphStatement(
+                QualifiedGraphName.parse(stmt.qualified_name),
+                self.process(stmt.inner))
+        if isinstance(stmt, ast.CatalogDropGraph):
+            return DropGraphStatement(QualifiedGraphName.parse(stmt.qualified_name))
+        raise IRBuildError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- single query -------------------------------------------------------
+
+    def _build_single(self, q: ast.SingleQuery) -> CypherQuery:
+        b = _SingleQueryBuilder(self)
+        for clause in q.clauses:
+            b.add_clause(clause)
+        return CypherQuery(tuple(b.blocks))
+
+
+class _SingleQueryBuilder:
+    def __init__(self, parent: IRBuilder):
+        self.parent = parent
+        self.schema = parent.ambient_schema
+        self.typer = SchemaTyper(self.schema, parent.parameters)
+        self.env: Dict[str, CypherType] = {}
+        self.blocks: List[Block] = []
+        self._anon = 0
+
+    def fresh(self, prefix: str) -> str:
+        self._anon += 1
+        return f"__{prefix}{self._anon}"
+
+    def _set_schema(self, schema: Schema) -> None:
+        self.schema = schema
+        self.typer = SchemaTyper(schema, self.parent.parameters)
+
+    # -- clause dispatch ----------------------------------------------------
+
+    def add_clause(self, clause: ast.Clause) -> None:
+        if isinstance(clause, ast.MatchClause):
+            self._add_match(clause)
+        elif isinstance(clause, ast.UnwindClause):
+            self._add_unwind(clause)
+        elif isinstance(clause, ast.WithClause):
+            self._add_projection(clause.body, where=clause.where, is_return=False)
+        elif isinstance(clause, ast.ReturnClause):
+            self._add_projection(clause.body, where=None, is_return=True)
+        elif isinstance(clause, ast.FromGraphClause):
+            self._add_from_graph(clause)
+        elif isinstance(clause, ast.ConstructClause):
+            self._add_construct(clause)
+        elif isinstance(clause, ast.ReturnGraphClause):
+            self.blocks.append(ReturnGraphBlock())
+        elif isinstance(clause, ast.CreateClause):
+            raise IRBuildError(
+                "CREATE as a query clause is not supported; use the graph "
+                "factory (caps_tpu.testing) or CONSTRUCT ... NEW")
+        else:
+            raise IRBuildError(f"unsupported clause {type(clause).__name__}")
+
+    # -- MATCH --------------------------------------------------------------
+
+    def _add_match(self, clause: ast.MatchClause) -> None:
+        entities: List[IRField] = []
+        connections: List[Connection] = []
+        bound: List[str] = []
+        predicates: List[E.Expr] = []
+        self._build_pattern(clause.pattern, entities, connections, bound,
+                            predicates)
+        if clause.where is not None:
+            predicates.extend(self._split_ands(clause.where))
+        predicates = [self._resolve_exists(p) for p in predicates]
+        self.blocks.append(MatchBlock(
+            Pattern(tuple(entities), tuple(connections), tuple(bound)),
+            tuple(predicates), clause.optional))
+
+    def _build_pattern(self, pattern: ast.Pattern, entities: List[IRField],
+                       connections: List[Connection], bound: List[str],
+                       predicates: List[E.Expr]) -> None:
+        """Declare an AST pattern's entities into the current env, emitting
+        connections and inline-property/label predicates."""
+
+        def declare_node(n: ast.NodePattern) -> str:
+            name = n.var or self.fresh("node")
+            if name in self.env:
+                if name not in bound:
+                    bound.append(name)
+                for lbl in n.labels:
+                    predicates.append(E.HasLabel(E.Var(name), lbl))
+            else:
+                self.env[name] = CTNode(n.labels)
+                entities.append(IRField(name, CTNode(n.labels)))
+            if n.properties is not None:
+                self._property_predicates(name, n.properties, predicates)
+            return name
+
+        for part in pattern.parts:
+            if part.path_var is not None:
+                raise IRBuildError("named paths are not supported yet")
+            elems = part.elements
+            prev = declare_node(elems[0])
+            i = 1
+            while i < len(elems):
+                rel: ast.RelPattern = elems[i]
+                node: ast.NodePattern = elems[i + 1]
+                nxt = declare_node(node)
+                rname = rel.var or self.fresh("rel")
+                if rname in self.env and rel.var is not None:
+                    raise IRBuildError(f"relationship variable `{rname}` already bound")
+                rel_ct: CypherType = CTRelationship(rel.rel_types)
+                if rel.var_length is not None:
+                    rel_ct = CTList(rel_ct)
+                self.env[rname] = rel_ct
+                entities.append(IRField(rname, rel_ct))
+                if rel.properties is not None:
+                    if rel.var_length is not None:
+                        raise IRBuildError(
+                            "property maps on variable-length relationships "
+                            "are not supported")
+                    self._property_predicates(rname, rel.properties, predicates)
+                direction = _DIRECTION[rel.direction]
+                if direction == Direction.INCOMING:
+                    connections.append(Connection(
+                        nxt, rname, prev, Direction.OUTGOING,
+                        rel.rel_types, rel.var_length))
+                else:
+                    connections.append(Connection(
+                        prev, rname, nxt, direction,
+                        rel.rel_types, rel.var_length))
+                prev = nxt
+                i += 2
+
+    # -- EXISTS subqueries ---------------------------------------------------
+
+    def _resolve_exists(self, expr: E.Expr) -> E.Expr:
+        """Rebind parser-stage ExistsSubQuery nodes (clause-AST pattern) to
+        IR-stage ones (ir Pattern + typed predicate tuple).  Resolution is
+        TOP-DOWN: a nested EXISTS must be built inside its enclosing
+        subquery's scope (after the enclosing pattern declared its vars),
+        which _build_exists does by recursing on the inner WHERE."""
+        if isinstance(expr, E.ExistsSubQuery):
+            if isinstance(expr.pattern, ast.Pattern):
+                return self._build_exists(expr)
+            return expr  # already IR-stage
+        return expr.map_children(
+            lambda c: self._resolve_exists(c) if isinstance(c, E.Expr) else c)
+
+    def _build_exists(self, sq: E.ExistsSubQuery) -> E.ExistsSubQuery:
+        saved_env = self.env
+        self.env = dict(saved_env)  # subquery scope: sees outer, adds local
+        try:
+            entities: List[IRField] = []
+            connections: List[Connection] = []
+            bound: List[str] = []
+            preds: List[E.Expr] = []
+            self._build_pattern(sq.pattern, entities, connections, bound,
+                                preds)
+            if sq.where is not None:
+                preds.extend(self._split_ands(
+                    self._resolve_exists(sq.where)))
+            pattern = Pattern(tuple(entities), tuple(connections),
+                              tuple(bound))
+            return E.ExistsSubQuery(pattern, None, tuple(preds))
+        finally:
+            self.env = saved_env
+
+    def _property_predicates(self, var: str, props: E.Expr,
+                             out: List[E.Expr]) -> None:
+        if isinstance(props, E.MapLit):
+            for k, v in zip(props.keys, props.values):
+                out.append(E.Equals(E.Property(E.Var(var), k), v))
+        elif isinstance(props, E.Param):
+            value = self.parent.parameters.get(props.name)
+            if isinstance(value, dict):
+                for k in value:
+                    out.append(E.Equals(E.Property(E.Var(var), k),
+                                        E.Index(props, E.Lit(k))))
+            else:
+                raise IRBuildError(
+                    f"pattern property parameter ${props.name} must be a map")
+        else:
+            raise IRBuildError("pattern properties must be a map literal or parameter")
+
+    @staticmethod
+    def _split_ands(e: E.Expr) -> List[E.Expr]:
+        if isinstance(e, E.Ands):
+            out: List[E.Expr] = []
+            for x in e.exprs:
+                out.extend(_SingleQueryBuilder._split_ands(x))
+            return out
+        return [e]
+
+    # -- UNWIND -------------------------------------------------------------
+
+    def _add_unwind(self, clause: ast.UnwindClause) -> None:
+        t = self.typer.type_of(clause.expr, self.env)
+        inner = t.material.inner if isinstance(t.material, _CTList) else CTAny
+        self.blocks.append(UnwindBlock(clause.expr, clause.var))
+        self.env[clause.var] = inner
+
+    # -- WITH / RETURN ------------------------------------------------------
+
+    def _add_projection(self, body: ast.ProjectionBody, where: Optional[E.Expr],
+                        is_return: bool) -> None:
+        items: List[Tuple[str, E.Expr]] = []
+        if body.star:
+            for name in sorted(self.env):
+                if not name.startswith("__"):
+                    items.append((name, E.Var(name)))
+        for item in body.items:
+            if item.alias is not None:
+                name = item.alias
+            elif isinstance(item.expr, E.Var):
+                name = item.expr.name
+            else:
+                name = item.expr.cypher_repr()
+            items.append((name, self._resolve_exists(item.expr)))
+        visible = [name for name, _ in items]
+        defining: Dict[str, E.Expr] = dict(items)
+
+        aggregating = any(E.is_aggregating(e) for _, e in items)
+        new_env: Dict[str, CypherType] = {}
+
+        if aggregating:
+            group: List[Tuple[str, E.Expr]] = []
+            aggs: List[Tuple[str, E.Aggregator]] = []
+            post: List[Tuple[str, E.Expr]] = []
+            needs_post = False
+            for name, expr in items:
+                if not E.is_aggregating(expr):
+                    group.append((name, expr))
+                    post.append((name, E.Var(name)))
+                elif isinstance(expr, E.Aggregator):
+                    aggs.append((name, expr))
+                    post.append((name, E.Var(name)))
+                else:
+                    # aggregator(s) nested inside a larger expression
+                    needs_post = True
+                    replaced = self._extract_aggs(expr, aggs)
+                    post.append((name, replaced))
+            for gname, gexpr in group:
+                for v in E.vars_in(gexpr):
+                    if v.name not in self.env:
+                        raise IRBuildError(f"variable `{v.name}` not in scope")
+            agg_env: Dict[str, CypherType] = {}
+            for gname, gexpr in group:
+                agg_env[gname] = self.typer.type_of(gexpr, self.env)
+            for aname, aexpr in aggs:
+                agg_env[aname] = self.typer.type_of(aexpr, self.env)
+            self.blocks.append(AggregationBlock(tuple(group), tuple(aggs)))
+            self.env = agg_env
+            if needs_post:
+                self.blocks.append(ProjectBlock(tuple(post), distinct=False))
+                new_env = {n: self.typer.type_of(x, agg_env) for n, x in post}
+                self.env = new_env
+            if body.distinct and needs_post:
+                # grouped output is unique per group key already unless a
+                # post-projection collapsed columns; re-distinct to be safe
+                self.blocks.append(ProjectBlock(
+                    tuple((n, E.Var(n)) for n, _ in post), distinct=True))
+        else:
+            project_items = list(items)
+            hidden: List[str] = []
+            order_rewritten: List[Tuple[E.Expr, bool]] = []
+            for oi in body.order_by:
+                expr = self._resolve_order_expr(
+                    self._resolve_exists(oi.expr), visible, defining)
+                # ORDER BY <expr> where <expr> is exactly a projected item's
+                # defining expression sorts by that item (openCypher rule).
+                for name, dexpr in items:
+                    if expr == dexpr:
+                        expr = E.Var(name)
+                        break
+                if self._uses_only(expr, visible):
+                    order_rewritten.append((expr, oi.ascending))
+                elif body.distinct:
+                    # With DISTINCT the sort key would join the distinct key
+                    # and change duplicate elimination; openCypher forbids it.
+                    raise IRBuildError(
+                        "with DISTINCT, ORDER BY may only reference "
+                        "projected columns")
+                else:
+                    hname = self.fresh("order")
+                    project_items.append((hname, expr))
+                    hidden.append(hname)
+                    order_rewritten.append((E.Var(hname), oi.ascending))
+            self.blocks.append(ProjectBlock(tuple(project_items), body.distinct))
+            new_env = {n: self.typer.type_of(x, self.env) for n, x in project_items}
+            self.env = new_env
+            if order_rewritten or body.skip is not None or body.limit is not None:
+                self.blocks.append(OrderAndSliceBlock(
+                    tuple(order_rewritten), body.skip, body.limit))
+            if hidden:
+                self.blocks.append(SelectBlock(tuple(visible)))
+                self.env = {n: t for n, t in self.env.items() if n in visible}
+
+        if aggregating and (body.order_by or body.skip is not None
+                            or body.limit is not None):
+            order_rewritten = []
+            for oi in body.order_by:
+                expr = self._resolve_order_expr(
+                    self._resolve_exists(oi.expr), visible, defining)
+                for name, dexpr in items:
+                    if expr == dexpr:  # ORDER BY a grouping-key expression
+                        expr = E.Var(name)
+                        break
+                if not self._uses_only(expr, list(self.env)):
+                    raise IRBuildError(
+                        "ORDER BY after aggregation may only reference "
+                        "projected columns")
+                order_rewritten.append((expr, oi.ascending))
+            self.blocks.append(OrderAndSliceBlock(
+                tuple(order_rewritten), body.skip, body.limit))
+
+        if where is not None:
+            self.blocks.append(FilterBlock(self._resolve_exists(where)))
+        if is_return:
+            self.blocks.append(ResultBlock(tuple(visible)))
+
+    def _extract_aggs(self, expr: E.Expr,
+                      aggs: List[Tuple[str, E.Aggregator]]) -> E.Expr:
+        def rule(n):
+            if isinstance(n, E.Aggregator):
+                for name, existing in aggs:
+                    if existing == n:
+                        return E.Var(name)
+                name = self.fresh("agg")
+                aggs.append((name, n))
+                return E.Var(name)
+            return n
+        return expr.transform_down(rule)
+
+    def _resolve_order_expr(self, expr: E.Expr, visible: List[str],
+                            defining: Dict[str, E.Expr]) -> E.Expr:
+        """ORDER BY sees both projected aliases and the pre-projection scope.
+        Rewrite alias references that are *not* pre-existing vars to their
+        defining expressions when mixed with old-scope vars."""
+        if self._uses_only(expr, visible):
+            return expr
+
+        def rule(n):
+            if isinstance(n, E.Var) and n.name in defining \
+                    and n.name not in self.env:
+                return defining[n.name]
+            return n
+        return expr.transform_down(rule)
+
+    @staticmethod
+    def _uses_only(expr: E.Expr, names: List[str]) -> bool:
+        return all(v.name in names for v in E.vars_in(expr))
+
+    # -- multiple graphs ----------------------------------------------------
+
+    def _add_from_graph(self, clause: ast.FromGraphClause) -> None:
+        qgn = QualifiedGraphName.parse(clause.qualified_name)
+        if self.parent.schema_resolver is None:
+            raise IRBuildError(
+                f"FROM GRAPH {qgn!r} requires a catalog (no schema resolver)")
+        self._set_schema(self.parent.schema_resolver(qgn))
+        self.blocks.append(FromGraphBlock(qgn))
+
+    def _add_construct(self, clause: ast.ConstructClause) -> None:
+        on = tuple(QualifiedGraphName.parse(g) for g in clause.on_graphs)
+        self.blocks.append(ConstructBlock(
+            on, clause.clones, clause.news, clause.sets))

@@ -1,0 +1,150 @@
+"""Logical plan optimizer.
+
+Mirrors the reference's ``LogicalOptimizer`` rewrites: label pushdown into
+scans and filter pushdown toward the sources (ref:
+okapi-logical/.../logical/impl/LogicalOptimizer.scala — reconstructed,
+mount empty; SURVEY.md §2).
+
+Both rewrites matter much more here than on Spark: filtering before an
+``Expand`` shrinks the gather/join the device executes, and narrowing scan
+labels picks a smaller node table outright.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional as Opt, Tuple
+
+from caps_tpu.ir import exprs as E
+from caps_tpu.logical import ops as L
+from caps_tpu.okapi.types import CTNode
+
+
+_MISSING = object()
+
+
+class LogicalOptimizer:
+    def __init__(self):
+        # Optional/ExistsSemiJoin rhs trees embed the lhs chain as a shared
+        # structural prefix that relational planning matches by equality to
+        # thread the row-id tag.  While rewriting such an rhs, the embedded
+        # lhs is a *barrier*: it is swapped for the already-rewritten lhs
+        # and never descended into (and _push won't push predicates across
+        # it), so the prefix stays structurally identical on both sides.
+        self._barriers = {}
+
+    def process(self, plan: L.LogicalPlan) -> L.LogicalPlan:
+        root = self._rewrite(plan.root)
+        return L.LogicalPlan(root, plan.result_fields, plan.returns_graph)
+
+    def _rewrite(self, op: L.LogicalOperator) -> L.LogicalOperator:
+        rep = self._barriers.get(op, _MISSING)
+        if rep is not _MISSING:
+            return rep
+        if isinstance(op, (L.Optional, L.ExistsSemiJoin)):
+            new_lhs = self._rewrite(op.lhs)
+            # Register the rewritten lhs too: once substituted into the rhs
+            # it is what _push/_rewrite actually encounter there.
+            saved = [(k, self._barriers.get(k, _MISSING))
+                     for k in (op.lhs, new_lhs)]
+            self._barriers[op.lhs] = new_lhs
+            self._barriers[new_lhs] = new_lhs
+            try:
+                new_rhs = self._rewrite(op.rhs)
+            finally:
+                for k, prev in saved:
+                    if prev is _MISSING:
+                        self._barriers.pop(k, None)
+                    else:
+                        self._barriers[k] = prev
+            return dataclasses.replace(op, lhs=new_lhs, rhs=new_rhs)
+        op = op.map_children(
+            lambda c: self._rewrite(c) if isinstance(c, L.LogicalOperator) else c)
+        if isinstance(op, L.Filter):
+            return self._optimize_filter(op)
+        return op
+
+    # -- filter / label pushdown -------------------------------------------
+
+    def _optimize_filter(self, op: L.Filter) -> L.LogicalOperator:
+        conjuncts = self._split(op.predicate)
+        child = op.parent
+        remaining = []
+        for pred in conjuncts:
+            pushed = self._push(child, pred)
+            if pushed is None:
+                remaining.append(pred)
+            else:
+                child = pushed
+        if not remaining:
+            return child
+        if child is op.parent and len(remaining) == len(conjuncts):
+            return op  # nothing changed: preserve sharing for Optional planning
+        pred = remaining[0] if len(remaining) == 1 else E.Ands(tuple(remaining))
+        return L.Filter(child, pred, fields=child.fields)
+
+    @staticmethod
+    def _split(pred: E.Expr) -> Tuple[E.Expr, ...]:
+        if isinstance(pred, E.Ands):
+            out = []
+            for p in pred.exprs:
+                out.extend(LogicalOptimizer._split(p))
+            return tuple(out)
+        return (pred,)
+
+    def _push(self, op: L.LogicalOperator, pred: E.Expr
+              ) -> Opt[L.LogicalOperator]:
+        """Try to push ``pred`` below ``op``; returns the rewritten operator
+        or None if the predicate must stay above."""
+        if op in self._barriers:
+            return None  # never rewrite across an Optional/Exists lhs prefix
+        needed = {v.name for v in E.vars_in(pred)}
+
+        # Label predicate meeting its producing scan/expand: absorb it.
+        if isinstance(pred, E.HasLabel) and isinstance(pred.node, E.Var):
+            var = pred.node.name
+            if isinstance(op, L.NodeScan) and op.var == var:
+                labels = frozenset(op.labels | {pred.label})
+                return L.NodeScan(op.parent, var, labels,
+                                  fields=((var, CTNode(labels)),))
+            if isinstance(op, (L.Expand, L.BoundedVarLengthExpand)) \
+                    and op.target == var and not op.into:
+                labels = frozenset(op.target_labels | {pred.label})
+                new_fields = tuple(
+                    (n, CTNode(labels)) if n == var else (n, t)
+                    for n, t in op.fields)
+                return dataclasses.replace(op, target_labels=labels,
+                                           fields=new_fields)
+
+        if isinstance(op, L.Filter):
+            inner = self._push(op.parent, pred)
+            if inner is not None:
+                return L.Filter(inner, op.predicate, fields=inner.fields)
+            return None
+        if isinstance(op, (L.Expand, L.BoundedVarLengthExpand)):
+            introduced = {op.rel} | ({op.target} if not op.into else set())
+            if needed & introduced:
+                return None
+            inner = self._push(op.parent, pred)
+            if inner is None:
+                inner = L.Filter(op.parent, pred, fields=op.parent.fields)
+            return dataclasses.replace(op, parent=inner)
+        if isinstance(op, L.CartesianProduct):
+            lhs_names = set(op.lhs.field_names)
+            rhs_names = set(op.rhs.field_names)
+            if needed <= lhs_names:
+                inner = self._push(op.lhs, pred) or \
+                    L.Filter(op.lhs, pred, fields=op.lhs.fields)
+                return L.CartesianProduct(inner, op.rhs, fields=op.fields)
+            if needed <= rhs_names:
+                inner = self._push(op.rhs, pred) or \
+                    L.Filter(op.rhs, pred, fields=op.rhs.fields)
+                return L.CartesianProduct(op.lhs, inner, fields=op.fields)
+            return None
+        if isinstance(op, L.FromGraph):
+            inner = self._push(op.parent, pred)
+            if inner is None:
+                return None
+            return L.FromGraph(inner, op.qgn, fields=inner.fields)
+        # NodeScan (different var), Start, Optional, Aggregate, Project,
+        # Select, Distinct, OrderBy, Skip, Limit, Unwind, unions: stop here.
+        return None

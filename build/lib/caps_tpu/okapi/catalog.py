@@ -1,0 +1,116 @@
+"""Concrete catalog: namespaces → data sources, with the default in-memory
+``session`` namespace.
+
+Mirrors the reference's ``CypherCatalog`` + ``SessionGraphDataSource``
+(ref: okapi-api/.../api/graph/CypherCatalog.scala and
+spark-cypher/.../impl/io/SessionGraphDataSource.scala — reconstructed,
+mount empty; SURVEY.md §2, §3.3).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple, Union
+
+from caps_tpu.okapi.graph import (
+    GraphName, Namespace, PropertyGraph, PropertyGraphCatalog, QualifiedGraphName,
+)
+from caps_tpu.okapi.io import PropertyGraphDataSource
+
+NameLike = Union[str, GraphName, QualifiedGraphName]
+
+
+def _qualify(name: NameLike) -> QualifiedGraphName:
+    if isinstance(name, QualifiedGraphName):
+        return name
+    if isinstance(name, GraphName):
+        return QualifiedGraphName(Namespace(), name)
+    return QualifiedGraphName.parse(name)
+
+
+class SessionGraphDataSource(PropertyGraphDataSource):
+    """The default in-memory source behind the ``session`` namespace."""
+
+    def __init__(self):
+        self._graphs: Dict[GraphName, PropertyGraph] = {}
+
+    def has_graph(self, name: GraphName) -> bool:
+        return name in self._graphs
+
+    def graph(self, name: GraphName) -> PropertyGraph:
+        if name not in self._graphs:
+            raise KeyError(f"graph {name!r} not found in session catalog")
+        return self._graphs[name]
+
+    def store(self, name: GraphName, graph: PropertyGraph) -> None:
+        self._graphs[name] = graph
+
+    def delete(self, name: GraphName) -> None:
+        self._graphs.pop(name, None)
+
+    def graph_names(self) -> Tuple[GraphName, ...]:
+        return tuple(self._graphs.keys())
+
+
+class CypherCatalog(PropertyGraphCatalog):
+    def __init__(self):
+        self._sources: Dict[Namespace, PropertyGraphDataSource] = {
+            Namespace(): SessionGraphDataSource()
+        }
+        # bumped on every mutation; part of the fused executor's plan key
+        self.version = 0
+
+    @property
+    def session_namespace(self) -> Namespace:
+        return Namespace()
+
+    def register_source(self, namespace: Namespace, source: PropertyGraphDataSource) -> None:
+        if isinstance(namespace, str):
+            namespace = Namespace(namespace)
+        if namespace in self._sources:
+            raise ValueError(f"namespace {namespace!r} already registered")
+        self._sources[namespace] = source
+        self.version += 1
+
+    def deregister_source(self, namespace: Namespace) -> None:
+        if isinstance(namespace, str):
+            namespace = Namespace(namespace)
+        if namespace == Namespace():
+            raise ValueError("cannot deregister the session namespace")
+        self._sources.pop(namespace, None)
+
+    def source(self, namespace: Namespace) -> PropertyGraphDataSource:
+        if isinstance(namespace, str):
+            namespace = Namespace(namespace)
+        if namespace not in self._sources:
+            raise KeyError(f"no data source registered for namespace {namespace!r}")
+        return self._sources[namespace]
+
+    @property
+    def namespaces(self) -> Tuple[Namespace, ...]:
+        return tuple(self._sources.keys())
+
+    def has_graph(self, name: NameLike) -> bool:
+        qgn = _qualify(name)
+        try:
+            return self.source(qgn.namespace).has_graph(qgn.graph_name)
+        except KeyError:
+            return False
+
+    def graph(self, name: NameLike) -> PropertyGraph:
+        qgn = _qualify(name)
+        return self.source(qgn.namespace).graph(qgn.graph_name)
+
+    def store(self, name: NameLike, graph: PropertyGraph) -> None:
+        qgn = _qualify(name)
+        self.source(qgn.namespace).store(qgn.graph_name, graph)
+        self.version += 1
+
+    def delete(self, name: NameLike) -> None:
+        qgn = _qualify(name)
+        self.source(qgn.namespace).delete(qgn.graph_name)
+        self.version += 1
+
+    def graph_names(self) -> Tuple[QualifiedGraphName, ...]:
+        out = []
+        for ns, src in self._sources.items():
+            out.extend(QualifiedGraphName(ns, gn) for gn in src.graph_names())
+        return tuple(out)

@@ -1,0 +1,86 @@
+"""Engine configuration and debug flags.
+
+The reference used a small homegrown flag registry backed by JVM system
+properties (PrintTimings/PrintIr/PrintLogicalPlan/PrintRelationalPlan/...)
+plus the SparkConf passed to the session builder (ref:
+okapi-api/.../okapi/impl/configuration/ — reconstructed, mount empty;
+SURVEY.md §5.6).  Here: one frozen dataclass with env-var overrides.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v is not None else default
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    # Debug printing (the reference's PrintIr / PrintLogicalPlan / ... flags)
+    print_timings: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_PRINT_TIMINGS", False))
+    print_ir: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_PRINT_IR", False))
+    print_logical_plan: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_PRINT_LOGICAL", False))
+    print_relational_plan: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_PRINT_RELATIONAL", False))
+
+    # Device backend tuning
+    # Row-count buckets: device tables are padded up to the next bucket so
+    # query programs compile once per (plan, bucket) key.
+    bucket_sizes: Tuple[int, ...] = (256, 1024, 4096, 16384, 65536, 262144, 1048576)
+    # Mesh shape for sharded execution; () = single device.
+    mesh_shape: Tuple[int, ...] = ()
+    mesh_axis: str = "shard"
+    # Kernel switches (pallas kernels fall back to jnp when off)
+    use_pallas: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_USE_PALLAS", True))
+    # HBM-resident CSR adjacency as the relationship scan's physical
+    # layout (ops/expand.py DeviceCSR); joins against it probe indptr
+    # instead of sorting + binary-searching the edge table.
+    use_csr: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_USE_CSR", True))
+    # Aggregate pushdown (relational/count_pattern.py): lower count-only
+    # pattern chains to SpMV over the adjacency instead of join+count.
+    use_count_pushdown: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_COUNT_PUSHDOWN", True))
+    # On a mesh, uniform pushdown chains use the ppermute ring schedule
+    # (parallel/ring.py) instead of XLA-inserted all-reduces.
+    use_ring: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_USE_RING", True))
+    # Fused executor (backends/tpu/fused.py): record data-dependent sizes
+    # on a query's first run, replay them sync-free on repeats.
+    use_fused: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_USE_FUSED", True))
+    # Compile-cache capacity (query programs keyed by plan+bucket shapes)
+    compile_cache_size: int = dataclasses.field(
+        default_factory=lambda: _env_int("CAPS_TPU_COMPILE_CACHE", 512))
+    # Determinism check (SURVEY.md §5.2): run each query twice and compare
+    # result digests; raises NondeterministicResultError on mismatch.
+    determinism_check: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_DETERMINISM_CHECK", False))
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.bucket_sizes:
+            if n <= b:
+                return b
+        # Beyond the largest bucket: round up to the next power of two.
+        b = self.bucket_sizes[-1]
+        while b < n:
+            b *= 2
+        return b
+
+
+DEFAULT_CONFIG = EngineConfig()

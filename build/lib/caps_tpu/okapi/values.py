@@ -1,0 +1,202 @@
+"""Runtime Cypher values, including null semantics.
+
+Mirrors the reference's value model: CypherValue, CypherMap, CypherList,
+CypherNode, CypherRelationship and the primitives (ref:
+okapi-api/.../api/value/CypherValue.scala — reconstructed, mount empty;
+SURVEY.md §2 "Value model").
+
+Python adaptation: primitives stay plain Python values (``None``, ``bool``,
+``int``, ``float``, ``str``, ``list``, ``dict``) — wrapping every scalar
+would fight the columnar backends.  The classes here cover the structured
+values that appear in materialized results, plus the Cypher comparison /
+equality / ordering helpers whose semantics differ from Python's
+(3-valued logic, cross-type global sort order, null handling).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, Mapping, Optional, Sequence, Tuple
+
+# `CypherValue` as a concept = None | bool | int | float | str | list | dict
+# | CypherNode | CypherRelationship.  Alias kept for API parity.
+CypherValue = Any
+
+
+class CypherList(list):
+    """Marker subclass for lists produced by the engine (e.g. collect())."""
+
+
+class CypherMap(dict):
+    """Marker subclass for maps produced by the engine."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CypherNode:
+    """A materialized node: identity, labels, properties."""
+    id: int
+    labels: FrozenLabels = ()
+    properties: Mapping[str, CypherValue] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "labels", tuple(sorted(self.labels)))
+        object.__setattr__(self, "properties", dict(self.properties))
+
+    def __eq__(self, other):  # identity semantics, like the reference
+        return isinstance(other, CypherNode) and other.id == self.id
+
+    def __hash__(self):
+        return hash(("node", self.id))
+
+    def __repr__(self):
+        lbl = "".join(f":{l}" for l in self.labels)
+        props = ", ".join(f"{k}: {_repr_value(v)}" for k, v in sorted(self.properties.items()))
+        return f"({lbl} {{{props}}})" if props else f"({lbl})"
+
+
+@dataclasses.dataclass(frozen=True)
+class CypherRelationship:
+    """A materialized relationship: identity, endpoints, type, properties."""
+    id: int
+    start: int
+    end: int
+    rel_type: str = ""
+    properties: Mapping[str, CypherValue] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "properties", dict(self.properties))
+
+    def __eq__(self, other):
+        return isinstance(other, CypherRelationship) and other.id == self.id
+
+    def __hash__(self):
+        return hash(("rel", self.id))
+
+    def __repr__(self):
+        props = ", ".join(f"{k}: {_repr_value(v)}" for k, v in sorted(self.properties.items()))
+        body = f":{self.rel_type}" + (f" {{{props}}}" if props else "")
+        return f"[{body}]"
+
+
+FrozenLabels = Tuple[str, ...]
+
+
+def _repr_value(v: CypherValue) -> str:
+    if isinstance(v, str):
+        return f"'{v}'"
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return repr(v)
+
+
+# ---------------------------------------------------------------------------
+# Cypher semantics helpers (3-valued logic, equality, global ordering)
+# ---------------------------------------------------------------------------
+
+def cypher_equals(a: CypherValue, b: CypherValue) -> Optional[bool]:
+    """Cypher `=`: returns True/False/None (null) with 3-valued semantics."""
+    if a is None or b is None:
+        return None
+    if isinstance(a, CypherNode) or isinstance(b, CypherNode):
+        return isinstance(a, CypherNode) and isinstance(b, CypherNode) and a.id == b.id
+    if isinstance(a, CypherRelationship) or isinstance(b, CypherRelationship):
+        return (isinstance(a, CypherRelationship)
+                and isinstance(b, CypherRelationship) and a.id == b.id)
+    if isinstance(a, bool) or isinstance(b, bool):
+        return isinstance(a, bool) and isinstance(b, bool) and a == b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a == b  # Python int/float comparison is exact, no precision loss
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        if len(a) != len(b):
+            return False
+        out: Optional[bool] = True
+        for x, y in zip(a, b):
+            e = cypher_equals(x, y)
+            if e is False:
+                return False
+            if e is None:
+                out = None
+        return out
+    if isinstance(a, dict) and isinstance(b, dict):
+        if set(a) != set(b):
+            return False
+        out = True
+        for k in a:
+            e = cypher_equals(a[k], b[k])
+            if e is False:
+                return False
+            if e is None:
+                out = None
+        return out
+    return False
+
+
+_ORDER_RANK = {
+    "map": 0, "node": 1, "rel": 2, "list": 3, "str": 4,
+    "bool": 5, "num": 6, "null": 7,
+}
+
+
+def _order_key(v: CypherValue) -> Tuple:
+    """Total order over all Cypher values (for ORDER BY): per openCypher,
+    within-type natural order; nulls sort last in ascending order."""
+    if v is None:
+        return (_ORDER_RANK["null"],)
+    if isinstance(v, bool):
+        return (_ORDER_RANK["bool"], v)
+    if isinstance(v, (int, float)):
+        return (_ORDER_RANK["num"], v)  # int/float cross-compare exactly
+    if isinstance(v, str):
+        return (_ORDER_RANK["str"], v)
+    if isinstance(v, CypherNode):
+        return (_ORDER_RANK["node"], v.id)
+    if isinstance(v, CypherRelationship):
+        return (_ORDER_RANK["rel"], v.id)
+    if isinstance(v, (list, tuple)):
+        return (_ORDER_RANK["list"], tuple(_order_key(x) for x in v))
+    if isinstance(v, dict):
+        return (_ORDER_RANK["map"], tuple(sorted((k, _order_key(x)) for k, x in v.items())))
+    raise TypeError(f"unorderable value {v!r}")
+
+
+def order_key(v: CypherValue) -> Tuple:
+    """Sort key for one ORDER BY item; descending order is realized by the
+    caller via per-item ``reverse=True`` in a multi-pass stable sort."""
+    return _order_key(v)
+
+
+def cypher_lt(a: CypherValue, b: CypherValue) -> Optional[bool]:
+    """Cypher `<`: null if either operand is null or the types are not
+    comparable (number vs string etc.)."""
+    if a is None or b is None:
+        return None
+    a_num = isinstance(a, (int, float)) and not isinstance(a, bool)
+    b_num = isinstance(b, (int, float)) and not isinstance(b, bool)
+    if a_num and b_num:
+        return a < b
+    if isinstance(a, str) and isinstance(b, str):
+        return a < b
+    if isinstance(a, bool) and isinstance(b, bool):
+        return a < b
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        for x, y in zip(a, b):
+            lt = cypher_lt(x, y)
+            if lt is None:
+                return None
+            if lt:
+                return True
+            gt = cypher_lt(y, x)
+            if gt is None:
+                return None
+            if gt:
+                return False
+        return len(a) < len(b)
+    return None
+
+
+def is_truthy(v: Optional[bool]) -> bool:
+    """WHERE keeps a row iff the predicate is exactly true (null drops)."""
+    return v is True

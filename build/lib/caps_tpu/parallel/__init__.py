@@ -1,0 +1,7 @@
+"""Distributed execution: device mesh, collectives, sharded query steps.
+
+The TPU-native replacement for the role Spark's shuffle service plays in
+the reference (SURVEY.md §5.8): tables shard over a ``jax.sharding.Mesh``;
+repartitioning is ``all_to_all``/``ppermute`` over ICI; broadcast joins are
+``all_gather``; global aggregates are ``psum``/segment-sum trees.
+"""

@@ -1,0 +1,63 @@
+"""Collective primitives for sharded query execution.
+
+The engine's "shuffle service" (SURVEY.md §5.8): thin wrappers over
+``jax.lax`` collectives used inside ``shard_map``ped query programs.
+
+    exchange_by_shard   all_to_all radix repartition by key hash — the
+                        analog of Spark's hash shuffle before joins/aggs
+    ring_shift          ppermute rotation — the ring schedule for k-hop
+                        frontier expansion against resident shards
+    broadcast_concat    all_gather of a small build side — broadcast join
+    global_sum          psum tree — global aggregates
+
+All take the mesh axis name; they only mean something inside shard_map.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def shard_of(key: jnp.ndarray, n_shards: int) -> jnp.ndarray:
+    """Destination shard for a join/group key (dense ids: range partition
+    by modulo — cheap and balanced for hashed/dense ids)."""
+    return (key % n_shards).astype(jnp.int32)
+
+
+def exchange_by_shard(data: jnp.ndarray, dest: jnp.ndarray, n_shards: int,
+                      axis: str, capacity: int) -> jnp.ndarray:
+    """All-to-all exchange: each device buckets its rows by ``dest`` into
+    fixed-capacity bins, then all_to_all delivers bin i to device i.
+    Returns the received (n_shards, capacity) buckets; slots beyond each
+    bin's fill are garbage — callers carry a validity channel the same way.
+    """
+    binned = jnp.zeros((n_shards, capacity), data.dtype)
+    # position of each row within its destination bin
+    one_hot = jax.nn.one_hot(dest, n_shards, dtype=jnp.int32)
+    pos = jnp.cumsum(one_hot, axis=0) - 1
+    row_pos = jnp.take_along_axis(pos, dest[:, None], axis=1)[:, 0]
+    ok = row_pos < capacity
+    binned = binned.at[dest, jnp.where(ok, row_pos, capacity - 1)].set(
+        jnp.where(ok, data, binned[0, 0]))
+    return lax.all_to_all(binned, axis, split_axis=0, concat_axis=0,
+                          tiled=False)
+
+
+def ring_shift(x: jnp.ndarray, axis: str, n_shards: int,
+               offset: int = 1) -> jnp.ndarray:
+    """Rotate a block one step around the ICI ring (ppermute) — the
+    communication pattern of ring attention, applied to frontier blocks in
+    multi-hop expansion (SURVEY.md §5.7)."""
+    perm = [(i, (i + offset) % n_shards) for i in range(n_shards)]
+    return lax.ppermute(x, axis, perm)
+
+
+def broadcast_concat(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """all_gather a small table side to every device (broadcast-hash join
+    analog of Spark's TorrentBroadcast)."""
+    return lax.all_gather(x, axis, tiled=True)
+
+
+def global_sum(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    return lax.psum(x, axis)

@@ -1,0 +1,26 @@
+"""Device mesh construction.
+
+One axis ("shard") for horizontal table/graph partitioning — the analog of
+the reference's Spark partition count (SURVEY.md §2 parallelism inventory
+item 1).  The same program runs on a 1-chip or v5e-8 mesh; mesh size is
+config, mirroring the reference's local[*] ≡ cluster property (§4 carry-over).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)} "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "with JAX_PLATFORMS=cpu for virtual meshes)")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))

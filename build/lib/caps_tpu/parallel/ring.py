@@ -1,0 +1,131 @@
+"""Ring-scheduled k-hop frontier expansion over the ICI.
+
+SURVEY.md §5.7: ``BoundedVarLengthExpand`` is the engine's "long sequence"
+— a data-dependent frontier growing hop by hop.  For sharded graphs the
+frontier (a dense per-node count vector, the aggregate-pushdown form of
+expansion — see query_step.py) is **node-block partitioned**, adjacency
+shards stay resident, and blocks rotate around the ring with ``ppermute``
+— ring attention's communication schedule with (gather ⋈ segment-sum) in
+place of (QKᵀ · softmax):
+
+    step t: shard s holds frontier block (s - t) mod S
+            local edges whose src falls in that block pick up cnt[src]
+    after S steps every local edge has its source count; one segment-sum
+    by dst + psum_scatter returns the next frontier, again block-sharded.
+
+Per hop each shard sends N/S counts S-1 times — the same bytes as an
+all_gather, but pipelined against the local gather so compute hides the
+ICI latency, and no shard ever materializes the full frontier.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_hop(cnt_block, edge_src, edge_dst, edge_ok, *, axis: str,
+              n_nodes: int, n_shards: int):
+    """One hop: node-block-sharded counts -> next counts, block-sharded."""
+    nb = n_nodes // n_shards
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(t, carry):
+        blk, acc = carry
+        block_id = (my - t) % n_shards
+        lo = block_id * nb
+        m = edge_ok & (edge_src >= lo) & (edge_src < lo + nb)
+        local = jnp.clip(edge_src - lo, 0, nb - 1)
+        acc = acc + jnp.where(m, blk[local], 0)
+        blk = jax.lax.ppermute(blk, axis, perm)
+        return blk, acc
+
+    # the accumulator becomes device-varying on the first iteration, so the
+    # loop carry must start with matching vma type
+    acc0 = jax.lax.pcast(jnp.zeros(edge_src.shape, cnt_block.dtype), axis,
+                         to="varying")
+    _, per_edge = jax.lax.fori_loop(0, n_shards, body, (cnt_block, acc0))
+    local_out = jax.ops.segment_sum(per_edge, edge_dst,
+                                    num_segments=n_nodes)
+    # psum + scatter back to node blocks in one collective
+    return jax.lax.psum_scatter(local_out, axis, scatter_dimension=0,
+                                tiled=True)
+
+
+def make_ring_khop(mesh: Mesh, n_nodes: int, n_hops: int,
+                   axis: str = "shard", masked: bool = False):
+    """Build the jitted k-hop ring expansion: seed counts and edges come
+    in sharded (node blocks / edge shards), result is the total path count
+    and the final block-sharded frontier.  With ``masked``, a node-block-
+    sharded mask vector is multiplied into the frontier after every hop
+    (the planner's per-hop node-existence/label mask)."""
+    n_shards = int(mesh.devices.size)
+    if n_nodes % n_shards:
+        raise ValueError(f"n_nodes {n_nodes} must divide over {n_shards}")
+    hop = functools.partial(_ring_hop, axis=axis, n_nodes=n_nodes,
+                            n_shards=n_shards)
+
+    def check_edges(edge_src, edge_dst, edge_ok):
+        for name, arr in (("edge_src", edge_src), ("edge_dst", edge_dst),
+                          ("edge_ok", edge_ok)):
+            if arr.shape[0] % n_shards:
+                raise ValueError(
+                    f"{name} length {arr.shape[0]} must divide over "
+                    f"{n_shards} shards; pad edges (edge_ok=False) to a "
+                    f"multiple of the shard count")
+
+    if masked:
+        def body(seed_block, edge_src, edge_dst, edge_ok, mask_block):
+            blk = seed_block
+            for _ in range(n_hops):
+                blk = hop(blk, edge_src, edge_dst, edge_ok) * mask_block
+            total = jax.lax.psum(blk.sum(), axis)
+            return total, blk
+        in_specs = (P(axis),) * 5
+    else:
+        def body(seed_block, edge_src, edge_dst, edge_ok):
+            blk = seed_block
+            for _ in range(n_hops):
+                blk = hop(blk, edge_src, edge_dst, edge_ok)
+            total = jax.lax.psum(blk.sum(), axis)
+            return total, blk
+        in_specs = (P(axis),) * 4
+
+    mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=(P(), P(axis)))
+    jitted = jax.jit(mapped)
+
+    def call(seed_block, edge_src, edge_dst, edge_ok, mask_block=None):
+        check_edges(edge_src, edge_dst, edge_ok)
+        if seed_block.shape[0] != n_nodes:
+            raise ValueError(f"seed length {seed_block.shape[0]} != n_nodes "
+                             f"{n_nodes}")
+        if masked != (mask_block is not None):
+            raise ValueError("mask_block must be passed iff masked=True")
+        args = (seed_block, edge_src, edge_dst, edge_ok)
+        return jitted(*args, mask_block) if masked else jitted(*args)
+
+    return call
+
+
+@functools.lru_cache(maxsize=128)
+def ring_khop_cached(mesh: Mesh, n_nodes: int, n_hops: int,
+                     axis: str = "shard", masked: bool = False):
+    """Memoized make_ring_khop: repeat queries reuse the traced + compiled
+    shard_map program instead of re-jitting per call."""
+    return make_ring_khop(mesh, n_nodes, n_hops, axis, masked)
+
+
+def ring_khop_reference(seed_counts, edge_src, edge_dst, edge_ok,
+                        n_hops: int, n_nodes: int):
+    """Single-device jnp twin for differential tests."""
+    cnt = seed_counts
+    for _ in range(n_hops):
+        per_edge = jnp.where(edge_ok, cnt[edge_src], 0)
+        cnt = jax.ops.segment_sum(per_edge, edge_dst,
+                                  num_segments=n_nodes)
+    return cnt.sum(), cnt

@@ -1,0 +1,207 @@
+"""Logical plan → relational operator tree.
+
+Mirrors the reference's ``RelationalPlanner`` — each LogicalOperator maps to
+RelationalOperators parameterized by the backend Table; Expand becomes
+Join(Join(rows, rel-scan), node-scan) on id columns (ref:
+okapi-relational/.../impl/RelationalPlanner.scala — reconstructed, mount
+empty; SURVEY.md §2, §3.2 "planExpand").
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional as Opt, Tuple
+
+from caps_tpu.ir import exprs as E
+from caps_tpu.ir.pattern import Direction
+from caps_tpu.logical import ops as L
+from caps_tpu.okapi.graph import QualifiedGraphName
+from caps_tpu.okapi.types import CTNode, CTRelationship
+from caps_tpu.relational import ops as R
+from caps_tpu.relational.graphs import RelationalCypherGraph
+from caps_tpu.relational.var_expand import VarExpandOp
+
+
+class RelationalPlanningError(Exception):
+    pass
+
+
+GraphResolver = Callable[[QualifiedGraphName], RelationalCypherGraph]
+
+
+class RelationalPlanner:
+    def __init__(self, context: R.RelationalRuntimeContext,
+                 ambient_graph: RelationalCypherGraph,
+                 graph_resolver: Opt[GraphResolver] = None):
+        self.context = context
+        self.ambient_graph = ambient_graph
+        self.graph_resolver = graph_resolver
+        self.current_graph = ambient_graph
+        self._memo: Dict[L.LogicalOperator, R.RelationalOperator] = {}
+        self._fresh = 0
+
+    def fresh(self, prefix: str) -> str:
+        self._fresh += 1
+        return f"__{prefix}_{self._fresh}"
+
+    def process(self, plan: L.LogicalPlan) -> R.RelationalOperator:
+        return self.plan_op(plan.root)
+
+    # ------------------------------------------------------------------
+
+    def plan_op(self, op: L.LogicalOperator) -> R.RelationalOperator:  # noqa: C901
+        # Memo keys are the logical ops themselves (frozen dataclasses, so
+        # structural): shared or structurally-identical subtrees plan to one
+        # relational operator, which Optional planning depends on.
+        if op in self._memo:
+            return self._memo[op]
+        out = self._plan_op(op)
+        self._memo[op] = out
+        return out
+
+    def _plan_op(self, op: L.LogicalOperator) -> R.RelationalOperator:  # noqa: C901
+        ctx = self.context
+        if isinstance(op, L.Start):
+            if op.qgn is not None and self.graph_resolver is not None:
+                self.current_graph = self.graph_resolver(op.qgn)
+            return R.StartOp(ctx)
+        if isinstance(op, L.NodeScan):
+            self.plan_op(op.parent)  # graph-context side effects (FromGraph)
+            return R.ScanOp(ctx, self.current_graph, op.var, CTNode(op.labels))
+        if isinstance(op, L.Expand):
+            return self._plan_expand(op)
+        if isinstance(op, L.BoundedVarLengthExpand):
+            parent = self.plan_op(op.parent)
+            return VarExpandOp(
+                ctx, parent, self.current_graph, op.source, op.rel,
+                op.rel_types, op.target, op.target_labels, op.direction,
+                op.lower, op.upper, op.into)
+        if isinstance(op, L.Filter):
+            return R.FilterOp(ctx, self.plan_op(op.parent), op.predicate)
+        if isinstance(op, L.Project):
+            parent = self.plan_op(op.parent)
+            env = dict(op.fields)
+            items = [(name, expr, env[name]) for name, expr in op.items]
+            return R.ProjectOp(ctx, parent, items)
+        if isinstance(op, L.Select):
+            return R.SelectOp(ctx, self.plan_op(op.parent), op.names)
+        if isinstance(op, L.Distinct):
+            return R.DistinctOp(ctx, self.plan_op(op.parent))
+        if isinstance(op, L.Aggregate):
+            parent = self.plan_op(op.parent)
+            env = dict(op.fields)
+            group = [(n, e, env[n]) for n, e in op.group]
+            aggs = [(n, a, env[n]) for n, a in op.aggregations]
+            default = R.AggregateOp(ctx, parent, group, aggs)
+            from caps_tpu.relational.count_pattern import (
+                try_plan_count_pushdown,
+            )
+            pushed = try_plan_count_pushdown(self, op, default)
+            return pushed if pushed is not None else default
+        if isinstance(op, L.OrderBy):
+            return R.OrderByOp(ctx, self.plan_op(op.parent), op.items)
+        if isinstance(op, L.Skip):
+            return R.SkipOp(ctx, self.plan_op(op.parent), op.expr)
+        if isinstance(op, L.Limit):
+            return R.LimitOp(ctx, self.plan_op(op.parent), op.expr)
+        if isinstance(op, L.Unwind):
+            env = dict(op.fields)
+            return R.UnwindOp(ctx, self.plan_op(op.parent), op.list_expr,
+                              op.var, env[op.var])
+        if isinstance(op, L.Optional):
+            tagged, rhs, rid = self._plan_optional(op.lhs, op.rhs)
+            return R.OptionalJoinOp(ctx, tagged, rhs, rid)
+        if isinstance(op, L.ExistsSemiJoin):
+            tagged, rhs, rid = self._plan_optional(op.lhs, op.rhs)
+            return R.ExistsJoinOp(ctx, tagged, rhs, rid, op.marker)
+        if isinstance(op, L.CartesianProduct):
+            l, r = self._plan_two(op.lhs, op.rhs)
+            return R.CrossOp(ctx, l, r)
+        if isinstance(op, L.ValueJoin):
+            pairs = []
+            for pred in op.predicates:
+                if not isinstance(pred, E.Equals):
+                    raise RelationalPlanningError(
+                        f"ValueJoin predicate must be equality: {pred!r}")
+                pairs.append((pred.lhs, pred.rhs))
+            l, r = self._plan_two(op.lhs, op.rhs)
+            return R.JoinOp(ctx, l, r, pairs, "inner")
+        if isinstance(op, L.TabularUnionAll):
+            l, r = self._plan_two(op.lhs, op.rhs, keep="pre")
+            return R.UnionAllOp(ctx, l, r)
+        if isinstance(op, L.FromGraph):
+            planned = self.plan_op(op.parent)
+            if self.graph_resolver is None:
+                raise RelationalPlanningError(
+                    f"FROM GRAPH {op.qgn!r} requires a catalog")
+            self.current_graph = self.graph_resolver(op.qgn)
+            return planned
+        if isinstance(op, (L.ConstructGraph, L.ReturnGraph)):
+            from caps_tpu.relational.construct import plan_construct
+            return plan_construct(self, op)
+        if isinstance(op, L.EmptyRecords):
+            return R.StartOp(ctx)
+        raise RelationalPlanningError(f"cannot plan {type(op).__name__}")
+
+    # -- branch-scoped graph context ----------------------------------------
+
+    def _plan_two(self, lhs: L.LogicalOperator, rhs: L.LogicalOperator,
+                  keep: str = "lhs"):
+        """Plan two independent subtrees with branch-scoped FROM GRAPH
+        effects: a graph switch inside one branch must not leak into its
+        sibling.  ``keep`` selects which graph context survives: the lhs
+        chain's ("lhs", the main chain for joins/products) or the
+        pre-branch one ("pre", for UNION where neither branch's switch
+        outlives the union)."""
+        pre = self.current_graph
+        l = self.plan_op(lhs)
+        lhs_graph = self.current_graph
+        self.current_graph = pre
+        r = self.plan_op(rhs)
+        self.current_graph = lhs_graph if keep == "lhs" else pre
+        return l, r
+
+    def _plan_optional(self, lhs: L.LogicalOperator, rhs: L.LogicalOperator):
+        """Optional-match planning: lhs is planned, tagged with a row index,
+        and the optional side is planned on the tagged lhs (it continues the
+        lhs graph context)."""
+        lhs_planned = self.plan_op(lhs)
+        rid = self.fresh("rid")
+        tagged = R.RowIndexOp(self.context, lhs_planned, rid)
+        self._memo[lhs] = tagged
+        rhs_planned = self.plan_op(rhs)
+        self._memo[lhs] = lhs_planned
+        return tagged, rhs_planned, rid
+
+    # -- Expand (SURVEY.md §3.2: the hot path generator) --------------------
+
+    def _plan_expand(self, op: L.Expand) -> R.RelationalOperator:
+        ctx = self.context
+        parent = self.plan_op(op.parent)
+        rel_var = E.Var(op.rel)
+        src_var = E.Var(op.source)
+        tgt_var = E.Var(op.target)
+        rel_ct = CTRelationship(op.rel_types)
+
+        def branch(outgoing: bool, rel_name: str) -> R.RelationalOperator:
+            rel_scan = R.ScanOp(ctx, self.current_graph, rel_name, rel_ct)
+            rv = E.Var(rel_name)
+            near = E.StartNode(rv) if outgoing else E.EndNode(rv)
+            far = E.EndNode(rv) if outgoing else E.StartNode(rv)
+            if op.into:
+                return R.JoinOp(ctx, parent, rel_scan,
+                                [(src_var, near), (tgt_var, far)], "inner")
+            j1 = R.JoinOp(ctx, parent, rel_scan, [(src_var, near)], "inner")
+            tgt_scan = R.ScanOp(ctx, self.current_graph, op.target,
+                                CTNode(op.target_labels))
+            return R.JoinOp(ctx, j1, tgt_scan, [(far, tgt_var)], "inner")
+
+        if op.direction == Direction.OUTGOING:
+            return branch(True, op.rel)
+        if op.direction == Direction.INCOMING:
+            return branch(False, op.rel)
+        # BOTH: union of the two orientations; exclude self-loops from the
+        # second branch so each loop edge matches exactly once.
+        out_b = branch(True, op.rel)
+        in_b = branch(False, op.rel)
+        in_b = R.FilterOp(ctx, in_b,
+                          E.Not(E.Equals(E.StartNode(rel_var), E.EndNode(rel_var))))
+        return R.UnionAllOp(ctx, out_b, in_b)

@@ -1,0 +1,339 @@
+"""Backend-generic session orchestration: the parse → IR → logical →
+relational → execute pipeline, result records, and entity materialization.
+
+Mirrors the reference's ``RelationalCypherSession`` / ``RelationalCypherRecords``
+(ref: okapi-relational/.../relational/api/ — reconstructed, mount empty;
+SURVEY.md §2, §3.1).
+"""
+from __future__ import annotations
+
+import abc
+import hashlib
+import logging
+import time
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+logger = logging.getLogger("caps_tpu")
+
+from caps_tpu.frontend.parser import parse_query
+from caps_tpu.ir import blocks as B
+from caps_tpu.ir import exprs as E
+from caps_tpu.ir.builder import IRBuilder
+from caps_tpu.logical.optimizer import LogicalOptimizer
+from caps_tpu.logical.planner import LogicalPlanner
+from caps_tpu.okapi.catalog import CypherCatalog
+from caps_tpu.okapi.config import DEFAULT_CONFIG, EngineConfig
+from caps_tpu.okapi.graph import (
+    CypherRecords, CypherResult, CypherSession, QualifiedGraphName,
+)
+from caps_tpu.okapi.schema import Schema
+from caps_tpu.okapi.types import (
+    CypherType, _CTList, _CTNode, _CTRelationship,
+)
+from caps_tpu.okapi.values import CypherNode, CypherRelationship
+from caps_tpu.relational import ops as R
+from caps_tpu.relational.graphs import EmptyGraph, RelationalCypherGraph, ScanGraph
+from caps_tpu.relational.header import RecordHeader
+from caps_tpu.relational.planner import RelationalPlanner
+from caps_tpu.relational.table import Table, TableFactory
+
+
+class NondeterministicResultError(RuntimeError):
+    """Raised by the determinism check (EngineConfig.determinism_check)
+    when a replayed query yields a different result multiset."""
+
+
+def result_digest(result: "CypherResult") -> str:
+    """Order-insensitive sha256 of a result's rows (multiset digest):
+    per-row digests are sorted before hashing, so any valid row order
+    yields the same digest."""
+    rows = result.to_maps()
+    row_digests = sorted(
+        hashlib.sha256(repr(sorted(r.items())).encode()).hexdigest()
+        for r in rows)
+    return hashlib.sha256("".join(row_digests).encode()).hexdigest()
+
+
+class RelationalCypherRecords(CypherRecords):
+    def __init__(self, session: "RelationalCypherSession", header: RecordHeader,
+                 table: Table, columns: Tuple[str, ...],
+                 graph: Optional[RelationalCypherGraph] = None):
+        self._session = session
+        self._header = header
+        self._table = table
+        self._columns = tuple(columns)
+        self._graph = graph
+
+    @property
+    def columns(self) -> Tuple[str, ...]:
+        return self._columns
+
+    @property
+    def header(self) -> RecordHeader:
+        return self._header
+
+    @property
+    def table(self) -> Table:
+        return self._table
+
+    def size(self) -> int:
+        return self._table.size
+
+    # -- materialization ----------------------------------------------------
+
+    def to_maps(self) -> List[Dict[str, Any]]:
+        header, table = self._header, self._table
+        n = table.size
+        out: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name in self._columns:
+            values = self._materialize_var(name, header, table, n)
+            for i in range(n):
+                out[i][name] = values[i]
+        return out
+
+    def _materialize_var(self, name: str, header: RecordHeader, table: Table,
+                         n: int) -> List[Any]:
+        var = E.Var(name)
+        t = header.type_of(var).material
+        if isinstance(t, _CTNode):
+            return self._materialize_nodes(name, header, table, n)
+        if isinstance(t, _CTRelationship):
+            return self._materialize_rels(name, header, table, n)
+        if isinstance(t, _CTList) and isinstance(t.inner.material,
+                                                 _CTRelationship):
+            ids_list = table.column_values(header.column(var))
+            lookup = self._rel_lookup()
+            return [None if ids is None else
+                    [self._rel_from_lookup(i, lookup) for i in ids]
+                    for ids in ids_list]
+        return table.column_values(header.column(var))
+
+    def _materialize_nodes(self, name, header, table, n) -> List[Any]:
+        var = E.Var(name)
+        ids = table.column_values(header.column(var))
+        label_cols = []
+        prop_cols = []
+        for e in header.exprs:
+            if isinstance(e, E.HasLabel) and e.node == var:
+                label_cols.append((e.label, table.column_values(header.column(e))))
+            elif isinstance(e, E.Property) and e.entity == var:
+                prop_cols.append((e.key, table.column_values(header.column(e))))
+        out = []
+        for i in range(n):
+            if ids[i] is None:
+                out.append(None)
+                continue
+            labels = tuple(lbl for lbl, col in label_cols if col[i] is True)
+            props = {k: col[i] for k, col in prop_cols if col[i] is not None}
+            out.append(CypherNode(ids[i], labels, props))
+        return out
+
+    def _materialize_rels(self, name, header, table, n) -> List[Any]:
+        var = E.Var(name)
+        ids = table.column_values(header.column(var))
+        srcs = table.column_values(header.column(E.StartNode(var)))
+        tgts = table.column_values(header.column(E.EndNode(var)))
+        types = table.column_values(header.column(E.Type(var)))
+        prop_cols = []
+        for e in header.exprs:
+            if isinstance(e, E.Property) and e.entity == var:
+                prop_cols.append((e.key, table.column_values(header.column(e))))
+        out = []
+        for i in range(n):
+            if ids[i] is None:
+                out.append(None)
+                continue
+            props = {k: col[i] for k, col in prop_cols if col[i] is not None}
+            out.append(CypherRelationship(ids[i], srcs[i], tgts[i],
+                                          types[i] or "", props))
+        return out
+
+    def _rel_lookup(self) -> Dict[int, Tuple[int, int, str, Dict[str, Any]]]:
+        if self._graph is None:
+            return {}
+        return self._graph.rel_lookup()
+
+    def _rel_from_lookup(self, rid, lookup) -> CypherRelationship:
+        if rid in lookup:
+            src, tgt, typ, props = lookup[rid]
+            return CypherRelationship(rid, src, tgt, typ, props)
+        return CypherRelationship(rid, -1, -1, "")
+
+
+class RelationalCypherResult(CypherResult):
+    def __init__(self, records: Optional[RelationalCypherRecords] = None,
+                 graph: Optional[RelationalCypherGraph] = None,
+                 plans: Optional[Dict[str, str]] = None,
+                 metrics: Optional[Dict[str, Any]] = None):
+        self._records = records
+        self._graph = graph
+        self.plans = plans or {}
+        self.metrics = metrics or {}
+
+    @property
+    def records(self) -> Optional[RelationalCypherRecords]:
+        return self._records
+
+    @property
+    def graph(self) -> Optional[RelationalCypherGraph]:
+        return self._graph
+
+    def to_maps(self) -> List[Dict[str, Any]]:
+        return self._records.to_maps() if self._records is not None else []
+
+    def explain(self) -> str:
+        parts = []
+        for phase in ("ir", "logical", "relational"):
+            if phase in self.plans:
+                parts.append(f"=== {phase.upper()} ===\n{self.plans[phase]}")
+        return "\n\n".join(parts)
+
+
+class RelationalCypherSession(CypherSession):
+    """Backend-generic session; concrete backends provide a TableFactory."""
+
+    def __init__(self, config: Optional[EngineConfig] = None):
+        self._catalog = CypherCatalog()
+        self.config = config or DEFAULT_CONFIG
+        self._ambient = EmptyGraph(self)
+
+    # -- backend SPI --------------------------------------------------------
+
+    @property
+    @abc.abstractmethod
+    def table_factory(self) -> TableFactory:
+        ...
+
+    # -- public API ---------------------------------------------------------
+
+    @property
+    def catalog(self) -> CypherCatalog:
+        return self._catalog
+
+    def cypher(self, query: str,
+               parameters: Optional[Mapping[str, Any]] = None) -> CypherResult:
+        return self.cypher_on_graph(self._ambient, query, parameters)
+
+    def cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
+                        parameters: Optional[Mapping[str, Any]] = None
+                        ) -> CypherResult:
+        result = self._cypher_on_graph(graph, query, parameters)
+        if self.config.determinism_check and result.records is not None:
+            # SURVEY.md §5.2: deterministic replay — run the same query a
+            # second time and compare multiset digests of the results.
+            again = self._cypher_on_graph(graph, query, parameters)
+            d1 = result_digest(result)
+            d2 = result_digest(again)
+            if d1 != d2:
+                raise NondeterministicResultError(
+                    f"query produced different results on replay "
+                    f"({d1[:12]} vs {d2[:12]}): {query!r}")
+            result.metrics["determinism_digest"] = d1
+        return result
+
+    def _cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
+                         parameters: Optional[Mapping[str, Any]] = None
+                         ) -> CypherResult:
+        t0 = time.perf_counter()
+        params = dict(parameters or {})
+        stmt = parse_query(query)
+
+        t1 = time.perf_counter()
+        ir = IRBuilder(graph.schema, self._schema_resolver, params).process(stmt)
+        t2 = time.perf_counter()
+
+        if isinstance(ir, B.CreateGraphStatement):
+            return self._run_create_graph(graph, ir, params)
+        if isinstance(ir, B.DropGraphStatement):
+            self._catalog.delete(ir.qgn)
+            return RelationalCypherResult()
+
+        logical = LogicalPlanner(graph.schema, self._schema_resolver,
+                                 params).process(ir)
+        logical = LogicalOptimizer().process(logical)
+        t3 = time.perf_counter()
+
+        context = R.RelationalRuntimeContext(self, params)
+        rel_planner = RelationalPlanner(context, graph, self._graph_resolver)
+        root = rel_planner.process(logical)
+        t4 = time.perf_counter()
+
+        plans = {"ir": ir.pretty(), "logical": logical.pretty(),
+                 "relational": root.pretty()}
+        if self.config.print_ir:
+            print(plans["ir"])
+        if self.config.print_logical_plan:
+            print(plans["logical"])
+        if self.config.print_relational_plan:
+            print(plans["relational"])
+
+        result_graph: Optional[RelationalCypherGraph] = None
+        records: Optional[RelationalCypherRecords] = None
+        if logical.returns_graph:
+            result_graph = self._evaluate_graph(root)
+        else:
+            header, table = root.result
+            records = RelationalCypherRecords(
+                self, header, table, logical.result_fields,
+                graph=rel_planner.current_graph)
+        t5 = time.perf_counter()
+
+        metrics = {
+            "parse_s": t1 - t0, "ir_s": t2 - t1, "plan_s": t3 - t2,
+            "relational_s": t4 - t3, "execute_s": t5 - t4,
+            "rows": records.size() if records is not None else 0,
+            "operators": context.op_metrics,
+        }
+        if self.config.print_timings:
+            print(f"[caps-tpu] timings: {metrics}")
+        logger.debug("query %r: %d rows in %.1f ms", query,
+                     metrics["rows"], 1e3 * (t5 - t0))
+        return RelationalCypherResult(records, result_graph, plans, metrics)
+
+    # -- graph-returning statements -----------------------------------------
+
+    def _run_create_graph(self, graph, ir: B.CreateGraphStatement, params):
+        """CATALOG CREATE GRAPH qgn { inner }: evaluate the inner query's
+        graph and store it under the qualified name."""
+        inner = ir.inner
+        logical = LogicalPlanner(graph.schema, self._schema_resolver,
+                                 params).process(inner)
+        logical = LogicalOptimizer().process(logical)
+        context = R.RelationalRuntimeContext(self, params)
+        planner = RelationalPlanner(context, graph, self._graph_resolver)
+        root = planner.process(logical)
+        if not logical.returns_graph:
+            raise ValueError(
+                "CATALOG CREATE GRAPH requires the inner query to end with "
+                "RETURN GRAPH")
+        result_graph = self._evaluate_graph(root)
+        self._catalog.store(ir.qgn, result_graph)
+        return RelationalCypherResult(graph=result_graph)
+
+    def _evaluate_graph(self, root: R.RelationalOperator):
+        result_graph = getattr(root, "result_graph", None)
+        if result_graph is None:
+            raise ValueError("query does not produce a graph")
+        return result_graph
+
+    def _schema_resolver(self, qgn: QualifiedGraphName) -> Schema:
+        src = self._catalog.source(qgn.namespace)
+        s = src.schema(qgn.graph_name)
+        if s is None:
+            raise KeyError(f"graph {qgn!r} not found")
+        return s
+
+    def _graph_resolver(self, qgn: QualifiedGraphName) -> RelationalCypherGraph:
+        g = self._catalog.graph(qgn)
+        if not isinstance(g, RelationalCypherGraph):
+            raise TypeError(f"graph {qgn!r} is not a relational graph")
+        return g
+
+    # -- helpers used by graphs ---------------------------------------------
+
+    def records_from(self, header: RecordHeader, table: Table,
+                     columns: Tuple[str, ...]) -> RelationalCypherRecords:
+        return RelationalCypherRecords(self, header, table, columns)
+
+    def create_graph(self, node_tables=(), rel_tables=()) -> ScanGraph:
+        return ScanGraph(self, node_tables, rel_tables)

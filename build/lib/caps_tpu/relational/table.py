@@ -1,0 +1,180 @@
+"""The columnar ``Table`` SPI — the port surface every backend implements.
+
+Mirrors the reference's ``Table[T]`` trait (select/filter/drop/join/
+unionAll/orderBy/skip/limit/distinct/group/withColumn/size/physicalColumns/
+columnType/rows/cache) (ref: okapi-relational/.../api/table/Table.scala —
+reconstructed, mount empty; SURVEY.md §2 "Table SPI").
+
+Like the reference — where ``filter(expr)`` takes an okapi ``Expr`` and each
+backend compiles it (SparkSQLExprMapper for Spark) — expression-bearing
+methods here receive ``(expr, header, parameters)`` and the backend brings
+its own expression compiler.  Aggregations and sort keys are pre-projected
+to physical columns by the relational planner, so ``group``/``order_by``
+deal in column names only.
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from caps_tpu.ir.exprs import Expr
+from caps_tpu.okapi.types import CypherType
+from caps_tpu.relational.header import RecordHeader
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregation over a pre-projected input column.
+
+    kind: count_star | count | sum | avg | min | max | collect | stdev
+          | percentile_cont | percentile_disc
+    """
+    name: str
+    kind: str
+    col: Optional[str] = None       # None for count_star
+    distinct: bool = False
+    percentile: Optional[float] = None
+    result_type: Optional[CypherType] = None
+
+
+JoinType = str  # "inner" | "left" | "cross"
+
+
+class Table(abc.ABC):
+    """Immutable columnar table."""
+
+    # -- shape --------------------------------------------------------------
+
+    @property
+    @abc.abstractmethod
+    def columns(self) -> Tuple[str, ...]:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def size(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def column_type(self, col: str) -> CypherType:
+        ...
+
+    # -- column ops ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def select(self, cols: Sequence[str]) -> "Table":
+        """Narrow to exactly these columns, in order."""
+
+    @abc.abstractmethod
+    def rename(self, mapping: Mapping[str, str]) -> "Table":
+        ...
+
+    @abc.abstractmethod
+    def with_column(self, name: str, expr: Expr, header: RecordHeader,
+                    parameters: Mapping[str, Any],
+                    cypher_type: CypherType) -> "Table":
+        """Append a column computed from ``expr`` (backend-compiled)."""
+
+    @abc.abstractmethod
+    def with_literal_column(self, name: str, value: Any,
+                            cypher_type: CypherType) -> "Table":
+        ...
+
+    @abc.abstractmethod
+    def with_row_index(self, name: str) -> "Table":
+        """Append a unique int64 row-id column (used for Optional joins)."""
+
+    @abc.abstractmethod
+    def copy_column(self, src: str, dst: str) -> "Table":
+        """Append ``dst`` as a copy of ``src`` (entity aliasing)."""
+
+    # -- row ops ------------------------------------------------------------
+
+    @abc.abstractmethod
+    def filter(self, expr: Expr, header: RecordHeader,
+               parameters: Mapping[str, Any]) -> "Table":
+        """Keep rows where ``expr`` evaluates to exactly true (3VL)."""
+
+    @abc.abstractmethod
+    def join(self, other: "Table", how: JoinType,
+             pairs: Sequence[Tuple[str, str]]) -> "Table":
+        """Join on equality of column pairs; null keys never match.
+        Column sets must be disjoint."""
+
+    @abc.abstractmethod
+    def union_all(self, other: "Table") -> "Table":
+        """Bag union; ``other`` must have the same columns."""
+
+    @abc.abstractmethod
+    def distinct(self) -> "Table":
+        ...
+
+    @abc.abstractmethod
+    def order_by(self, items: Sequence[Tuple[str, bool]]) -> "Table":
+        """Stable multi-key sort; (column, ascending); Cypher null ordering
+        (nulls last ascending, first descending)."""
+
+    @abc.abstractmethod
+    def skip(self, n: int) -> "Table":
+        ...
+
+    @abc.abstractmethod
+    def limit(self, n: int) -> "Table":
+        ...
+
+    @abc.abstractmethod
+    def group(self, by: Sequence[str], aggs: Sequence[AggSpec]) -> "Table":
+        """Group by columns, compute aggregations.  Empty ``by`` = one
+        global group (which aggregates over zero rows to count=0/sum=0/
+        null for min/max/avg, per Cypher)."""
+
+    @abc.abstractmethod
+    def explode(self, list_col: str, out_col: str,
+                out_type: CypherType) -> "Table":
+        """UNWIND: one output row per element of ``list_col``; empty lists
+        and nulls produce no rows."""
+
+    @abc.abstractmethod
+    def pack_list(self, cols: Sequence[str], out_col: str,
+                  out_type: CypherType) -> "Table":
+        """Combine columns into one list-valued column per row, skipping
+        nulls (used for variable-length relationship lists)."""
+
+    # -- materialization ----------------------------------------------------
+
+    @abc.abstractmethod
+    def column_values(self, col: str) -> List[Any]:
+        """Materialize one column to host Python values (None for null)."""
+
+    def rows(self) -> List[Dict[str, Any]]:
+        cols = self.columns
+        data = {c: self.column_values(c) for c in cols}
+        return [{c: data[c][i] for c in cols} for i in range(self.size)]
+
+    def cache(self) -> "Table":
+        return self
+
+
+class TableFactory(abc.ABC):
+    """Backend-side constructors for tables."""
+
+    @abc.abstractmethod
+    def from_columns(self, data: Mapping[str, Sequence[Any]],
+                     types: Mapping[str, CypherType]) -> Table:
+        ...
+
+    @abc.abstractmethod
+    def unit(self) -> Table:
+        """One row, zero columns (the Start operator's table)."""
+
+    @abc.abstractmethod
+    def empty(self, cols: Sequence[str],
+              types: Mapping[str, CypherType]) -> Table:
+        ...
+
+    def prepare_rel_table(self, rel_table) -> None:
+        """Backend hook called once per relationship table at graph
+        creation: device backends build their physical adjacency layout
+        (HBM-resident CSR over the source/target columns) here so every
+        later Expand hop probes it.  Default: no-op."""

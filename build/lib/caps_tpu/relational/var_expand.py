@@ -1,0 +1,157 @@
+"""Bounded variable-length expand.
+
+Mirrors the reference's ``planBoundedVarLengthExpand`` — iterative
+join-and-union up to the upper bound with relationship-uniqueness (edge
+isomorphism) filters (ref: okapi-relational planner — reconstructed,
+mount empty; SURVEY.md §3.2).
+
+The unroll is static: hop ``k`` joins the frontier against a per-hop copy
+of the relationship scan; every new hop id is filtered against all previous
+hop ids; lengths ``lower..upper`` are unioned, with traversed relationship
+ids packed into one list-valued column.  Static unrolling is deliberate —
+on the TPU backend every hop is a fixed-shape join the compiler can fuse,
+the device-side analog of ragged frontier schedules (SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+from typing import List, Optional as Opt, Tuple
+
+from caps_tpu.ir import exprs as E
+from caps_tpu.ir.pattern import Direction
+from caps_tpu.okapi.types import (
+    CTInteger, CTList, CTNode, CTRelationship, CypherType,
+)
+from caps_tpu.relational.header import RecordHeader
+from caps_tpu.relational.ops import RelationalOperator
+from caps_tpu.relational.table import Table
+
+# Safety cap for unbounded `[*]` patterns (the reference requires Spark to
+# materialize each iteration too; unbounded expansion needs *some* limit).
+DEFAULT_UNBOUNDED_UPPER = 10
+
+
+def synth_header(table: Table) -> RecordHeader:
+    """A header mapping every physical column to ``Var(col)`` — used for
+    internal columnar filtering where no user-level header applies."""
+    return RecordHeader([(E.Var(c), c, table.column_type(c))
+                         for c in table.columns])
+
+
+class VarExpandOp(RelationalOperator):
+    def __init__(self, context, parent: RelationalOperator, graph,
+                 source: str, rel: str, rel_types: Tuple[str, ...],
+                 target: str, target_labels, direction: Direction,
+                 lower: int, upper: Opt[int], into: bool):
+        super().__init__(context, [parent])
+        self.graph = graph
+        self.source = source
+        self.rel = rel
+        self.rel_types = rel_types
+        self.target = target
+        self.target_labels = frozenset(target_labels)
+        self.direction = direction
+        self.lower = lower
+        self.upper = upper if upper is not None else max(
+            lower, DEFAULT_UNBOUNDED_UPPER)
+        self.into = into
+
+    # ------------------------------------------------------------------
+
+    def _rel_hop_table(self, k: int) -> Tuple[Table, str, str, str]:
+        """The relationship table for hop ``k`` with per-hop column names
+        (id, near, far) following the traversal direction."""
+        tmp_var = f"__vle{k}"
+        header, t = self.graph.scan_rel(tmp_var, self.rel_types)
+        idc = header.column(E.Var(tmp_var))
+        src = header.column(E.StartNode(E.Var(tmp_var)))
+        tgt = header.column(E.EndNode(E.Var(tmp_var)))
+        t = t.select([idc, src, tgt])
+        hid, hnear, hfar = f"__hop{k}_id", f"__hop{k}_near", f"__hop{k}_far"
+        if self.direction == Direction.OUTGOING:
+            t = t.rename({idc: hid, src: hnear, tgt: hfar})
+        elif self.direction == Direction.INCOMING:
+            t = t.rename({idc: hid, tgt: hnear, src: hfar})
+        else:  # BOTH: traverse each edge in either orientation
+            fwd = t.rename({idc: hid, src: hnear, tgt: hfar})
+            bwd = t.rename({idc: hid, tgt: hnear, src: hfar})
+            sh = synth_header(bwd)
+            bwd = bwd.filter(
+                E.Not(E.Equals(E.Var(hnear), E.Var(hfar))), sh, {})
+            fwd = fwd.select([hid, hnear, hfar])
+            bwd = bwd.select([hid, hnear, hfar])
+            t = fwd.union_all(bwd)
+        return t.select([hid, hnear, hfar]), hid, hnear, hfar
+
+    def _compute(self):
+        parent_header, parent_table = self.children[0].result
+        params = self.context.parameters
+        rel_list_type: CypherType = CTList(CTRelationship(self.rel_types))
+
+        src_id_col = parent_header.column(E.Var(self.source))
+        if self.into:
+            tgt_header = None
+            tgt_id_col = parent_header.column(E.Var(self.target))
+            final_cols = list(parent_table.columns) + [self.rel]
+        else:
+            tgt_header, tgt_table = self.graph.scan_node(
+                self.target, self.target_labels)
+            tgt_id_col = tgt_header.column(E.Var(self.target))
+            final_cols = list(parent_table.columns) + [self.rel] \
+                + list(tgt_header.columns)
+
+        cur = "__vle_cur"
+        frontier = parent_table.copy_column(src_id_col, cur)
+        hop_id_cols: List[str] = []
+        branches: List[Table] = []
+
+        def finish_branch(t: Table, hops: List[str]) -> Table:
+            """Pack hop ids into the rel list column, join/filter target,
+            project to the uniform final column set."""
+            t = t.pack_list(hops, self.rel, rel_list_type)
+            if self.into:
+                sh = synth_header(t)
+                t = t.filter(E.Equals(E.Var(cur), E.Var(tgt_id_col)), sh, params)
+                return t.select(final_cols)
+            tt = tgt_table.rename({c: f"__t_{c}" for c in tgt_table.columns})
+            joined = t.join(tt, "inner", [(cur, f"__t_{tgt_id_col}")])
+            joined = joined.rename(
+                {f"__t_{c}": c for c in tgt_table.columns})
+            return joined.select(final_cols)
+
+        if self.lower == 0:
+            branches.append(finish_branch(frontier, []))
+
+        for k in range(1, self.upper + 1):
+            hop_t, hid, hnear, hfar = self._rel_hop_table(k)
+            joined = frontier.join(hop_t, "inner", [(cur, hnear)])
+            # edge-isomorphism: this hop's rel must differ from all previous
+            sh = synth_header(joined)
+            for prev in hop_id_cols:
+                joined = joined.filter(
+                    E.Not(E.Equals(E.Var(hid), E.Var(prev))), sh, params)
+            # advance the frontier cursor to the far end of this hop
+            joined = joined.select(
+                [c for c in joined.columns if c not in (cur, hnear)])
+            joined = joined.copy_column(hfar, cur)
+            joined = joined.select(
+                [c for c in joined.columns if c != hfar])
+            frontier = joined
+            hop_id_cols = hop_id_cols + [hid]
+            if k >= self.lower:
+                branches.append(finish_branch(frontier, hop_id_cols))
+
+        if not branches:
+            raise ValueError("variable-length expand produced no branches")
+        out = branches[0]
+        for b in branches[1:]:
+            out = out.union_all(b)
+
+        out_header = parent_header.with_expr(E.Var(self.rel), rel_list_type,
+                                             column=self.rel)
+        if not self.into and tgt_header is not None:
+            out_header = out_header.concat(tgt_header)
+        return out_header, out.select(list(out_header.columns))
+
+    def _pretty_args(self):
+        return (f"({self.source})-[{self.rel}:{'|'.join(self.rel_types)}"
+                f"*{self.lower}..{self.upper}]-({self.target})")

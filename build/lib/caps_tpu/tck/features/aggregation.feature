@@ -1,0 +1,160 @@
+Feature: Aggregation
+
+  Scenario: count star over all rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P), (:P), (:Q)
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 3 |
+
+  Scenario: count of an expression skips nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 2}), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN count(p.x) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: count on an empty match returns zero
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+
+  Scenario: min max sum avg on an empty match return null
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n:Nope) RETURN count(*) AS c, min(n.v) AS mn, max(n.v) AS mx, sum(n.v) AS s, avg(n.v) AS a
+      """
+    Then the result should be, in any order:
+      | c | mn   | mx   | s | a    |
+      | 0 | null | null | 0 | null |
+
+  Scenario: min max over an all-null property return null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN min(p.x) AS mn, max(p.x) AS mx
+      """
+    Then the result should be, in any order:
+      | mn   | mx   |
+      | null | null |
+
+  Scenario: collect on an empty match returns the empty list
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n:Nope) RETURN collect(n.v) AS l
+      """
+    Then the result should be, in any order:
+      | l  |
+      | [] |
+
+  Scenario: sum avg min max over a grouping key
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'a', x: 1}), (:P {g: 'a', x: 3}), (:P {g: 'b', x: 5})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.g AS g, sum(p.x) AS s, avg(p.x) AS a, min(p.x) AS mn, max(p.x) AS mx
+      """
+    Then the result should be, in any order:
+      | g   | s | a   | mn | mx |
+      | 'a' | 4 | 2.0 | 1  | 3  |
+      | 'b' | 5 | 5.0 | 5  | 5  |
+
+  Scenario: aggregates ignore null values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 2}), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN sum(p.x) AS s, min(p.x) AS mn, avg(p.x) AS a
+      """
+    Then the result should be, in any order:
+      | s | mn | a   |
+      | 2 | 2  | 2.0 |
+
+  Scenario: collect gathers values and skips nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 2}), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p.x AS x ORDER BY x RETURN collect(x) AS l
+      """
+    Then the result should be, in any order:
+      | l      |
+      | [1, 2] |
+
+  Scenario: count DISTINCT
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 1}), (:P {x: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN count(DISTINCT p.x) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: grouped count over relationships
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (c:P {n: 'c'}), (a)-[:T]->(b), (a)-[:T]->(c), (b)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (p:P)-[:T]->() RETURN p.n AS n, count(*) AS deg
+      """
+    Then the result should be, in any order:
+      | n   | deg |
+      | 'a' | 2   |
+      | 'b' | 1   |
+
+  Scenario: aggregation then further processing with WITH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'a', x: 1}), (:P {g: 'a', x: 2}), (:P {g: 'b', x: 9})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p.g AS g, sum(p.x) AS s WHERE s > 2 RETURN g, s ORDER BY g
+      """
+    Then the result should be, in order:
+      | g   | s |
+      | 'a' | 3 |
+      | 'b' | 9 |

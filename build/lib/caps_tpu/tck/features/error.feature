@@ -1,0 +1,33 @@
+Feature: Error reporting
+
+  Scenario: unclosed node pattern is a syntax error
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (a RETURN a
+      """
+    Then a SyntaxError should be raised at compile time: InvalidSyntax
+
+  Scenario: returning an undefined variable is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN undefinedVar
+      """
+    Then a SyntaxError should be raised at compile time: UndefinedVariable
+
+  Scenario: aggregation inside WHERE is an error
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) WHERE count(n) > 1 RETURN n
+      """
+    Then a SyntaxError should be raised at compile time: InvalidAggregation
+
+  Scenario: ORDER BY on a variable not in scope is an error
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN n.x AS x ORDER BY banana
+      """
+    Then a SyntaxError should be raised at compile time: UndefinedVariable

@@ -1,0 +1,128 @@
+Feature: Exists subqueries
+
+  Scenario: EXISTS filters to rows with a match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (c:Q), (a)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE EXISTS { (p)-[:T]->(:Q) } RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: NOT EXISTS keeps only rows without a match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (c:Q), (a)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE NOT EXISTS { MATCH (p)-[:T]->() } RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+
+  Scenario: EXISTS with an inner WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}),
+             (x:Q {v: 1}), (y:Q {v: 9}),
+             (a)-[:T]->(x), (b)-[:T]->(y)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE EXISTS { MATCH (p)-[:T]->(q:Q) WHERE q.v > 5 } RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+
+  Scenario: EXISTS as a returned value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (a)-[:T]->(a)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.n AS n, EXISTS { (p)-[:T]->() } AS has
+      """
+    Then the result should be, in any order:
+      | n   | has   |
+      | 'a' | true  |
+      | 'b' | false |
+
+  Scenario: EXISTS does not multiply rows for multiple matches
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:Q), (c:Q), (a)-[:T]->(b), (a)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE EXISTS { (p)-[:T]->(:Q) } RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+
+  Scenario: nested EXISTS applies label constraints on enclosing-pattern vars
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P), (a)-[:T]->(b)
+      """
+    When executing query:
+      """
+      MATCH (a:P) WHERE EXISTS { (a)-[:T]->(b) WHERE EXISTS { (b:Robot)-[:T]->(c) } } RETURN a.n AS n
+      """
+    Then the result should be empty
+
+  Scenario: EXISTS with a label constraint on an outer-bound variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:Q), (a)-[:T]->(b)
+      """
+    When executing query:
+      """
+      MATCH (a:P) WHERE EXISTS { (a:Robot)-[:T]->(b) } RETURN a.n AS n
+      """
+    Then the result should be empty
+
+  Scenario: ORDER BY an EXISTS subquery
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (c:P {n: 'c'}), (a)-[:T]->(b), (c)-[:T]->(b)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.n AS n ORDER BY EXISTS { (p)-[:T]->() } DESC, n
+      """
+    Then the result should be, in order:
+      | n   |
+      | 'a' |
+      | 'c' |
+      | 'b' |
+
+  Scenario: EXISTS over a disconnected pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'}), (:R)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE EXISTS { MATCH (:R) } RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |

@@ -1,0 +1,152 @@
+Feature: Functions
+
+  Scenario: string case and trim functions
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toUpper('ab') AS u, toLower('AB') AS l, trim('  x ') AS t
+      """
+    Then the result should be, in any order:
+      | u    | l    | t   |
+      | 'AB' | 'ab' | 'x' |
+
+  Scenario: substring replace and split
+    Given an empty graph
+    When executing query:
+      """
+      RETURN substring('hello', 1, 3) AS s, replace('aaa', 'a', 'b') AS r, split('a,b', ',') AS p
+      """
+    Then the result should be, in any order:
+      | s     | r     | p          |
+      | 'ell' | 'bbb' | ['a', 'b'] |
+
+  Scenario: numeric functions
+    Given an empty graph
+    When executing query:
+      """
+      RETURN abs(-3) AS a, sign(-2) AS s, floor(1.7) AS f, ceil(1.2) AS c, round(1.5) AS r
+      """
+    Then the result should be, in any order:
+      | a | s  | f   | c   | r   |
+      | 3 | -1 | 1.0 | 2.0 | 2.0 |
+
+  Scenario: sqrt and exponentials
+    Given an empty graph
+    When executing query:
+      """
+      RETURN sqrt(9.0) AS q, log(e()) AS l
+      """
+    Then the result should be, in any order:
+      | q   | l   |
+      | 3.0 | 1.0 |
+
+  Scenario: size of lists and strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN size([1, 2, 3]) AS ls, size('abcd') AS ss
+      """
+    Then the result should be, in any order:
+      | ls | ss |
+      | 3  | 4  |
+
+  Scenario: head last and tail
+    Given an empty graph
+    When executing query:
+      """
+      RETURN head([1, 2, 3]) AS h, last([1, 2, 3]) AS l, tail([1, 2, 3]) AS t
+      """
+    Then the result should be, in any order:
+      | h | l | t      |
+      | 1 | 3 | [2, 3] |
+
+  Scenario: range function
+    Given an empty graph
+    When executing query:
+      """
+      RETURN range(1, 4) AS r, range(0, 6, 2) AS s
+      """
+    Then the result should be, in any order:
+      | r            | s         |
+      | [1, 2, 3, 4] | [0, 2, 4, 6] |
+
+  Scenario: type conversions
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger('42') AS i, toFloat('2.5') AS f, toString(7) AS s, toBoolean('true') AS b
+      """
+    Then the result should be, in any order:
+      | i  | f   | s   | b    |
+      | 42 | 2.5 | '7' | true |
+
+  Scenario: labels of a node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {x: 1})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN labels(n) AS l
+      """
+    Then the result should be, in any order:
+      | l          |
+      | ['A', 'B'] |
+
+  Scenario: type of a relationship
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:KNOWS]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r]->() RETURN type(r) AS t
+      """
+    Then the result should be, in any order:
+      | t       |
+      | 'KNOWS' |
+
+  Scenario: keys and properties of a node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {b: 2, a: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:P) RETURN keys(n) AS k, properties(n) AS p
+      """
+    Then the result should be, in any order:
+      | k          | p            |
+      | ['a', 'b'] | {a: 1, b: 2} |
+
+  Scenario: CASE expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.x AS x, CASE WHEN p.x = 1 THEN 'one' ELSE 'many' END AS w
+      """
+    Then the result should be, in any order:
+      | x | w      |
+      | 1 | 'one'  |
+      | 2 | 'many' |
+
+  Scenario: functions applied to null propagate null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN toUpper(p.s) AS u, abs(p.x) AS a, size(p.l) AS z
+      """
+    Then the result should be, in any order:
+      | u    | a    | z    |
+      | null | null | null |

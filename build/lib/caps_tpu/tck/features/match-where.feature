@@ -1,0 +1,161 @@
+Feature: Match where
+
+  Scenario: Filter on a numeric comparison
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {age: 20}), (:P {age: 30}), (:P {age: 40})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.age > 25 RETURN p.age AS age
+      """
+    Then the result should be, in any order:
+      | age |
+      | 30  |
+      | 40  |
+
+  Scenario: Comparison against a missing property is null and filters the row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {age: 20}), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.age < 99 RETURN p.age AS age
+      """
+    Then the result should be, in any order:
+      | age |
+      | 20  |
+
+  Scenario: Conjunction and disjunction
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 1}), (:P {a: 1, b: 2}), (:P {a: 2, b: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.a = 1 AND p.b = 2 OR p.a = 2 RETURN p.a AS a, p.b AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 1 | 2 |
+      | 2 | 2 |
+
+  Scenario: Negation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'x', keep: true}), (:P {n: 'y', keep: false})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE NOT p.keep RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'y' |
+
+  Scenario: IN list predicate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 2}), (:P {x: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.x IN [1, 3, 5] RETURN p.x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 3 |
+
+  Scenario: IS NULL and IS NOT NULL
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'has', x: 1}), (:P {n: 'hasnt'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.x IS NULL RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n       |
+      | 'hasnt' |
+
+  Scenario: String predicates
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {s: 'apple'}), (:P {s: 'banana'}), (:P {s: 'apricot'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.s STARTS WITH 'ap' AND p.s CONTAINS 'ric' RETURN p.s AS s
+      """
+    Then the result should be, in any order:
+      | s         |
+      | 'apricot' |
+
+  Scenario: ENDS WITH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {s: 'apple'}), (:P {s: 'maple'}), (:P {s: 'oak'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.s ENDS WITH 'ple' RETURN p.s AS s
+      """
+    Then the result should be, in any order:
+      | s       |
+      | 'apple' |
+      | 'maple' |
+
+  Scenario: Filter on label in WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:X {n: 1}), (:A {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A) WHERE a:X RETURN a.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+
+  Scenario: Filter with a parameter
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 2})
+      """
+    And parameters are:
+      | min | 1 |
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.x > $min RETURN p.x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 2 |
+
+  Scenario: Equality between two node properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {v: 1}), (b:B {v: 1}), (c:B {v: 2}), (a)-[:T]->(b), (a)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (x:A)-[:T]->(y:B) WHERE x.v = y.v RETURN y.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |

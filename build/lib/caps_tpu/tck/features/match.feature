@@ -1,0 +1,193 @@
+Feature: Match
+
+  Scenario: Match all nodes in an empty graph
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n) RETURN n
+      """
+    Then the result should be empty
+
+  Scenario: Match all nodes
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {x: 1}), (:B {x: 2})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN n
+      """
+    Then the result should be, in any order:
+      | n            |
+      | (:A {x: 1})  |
+      | (:B {x: 2})  |
+
+  Scenario: Match nodes by label
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {x: 1}), (:B {x: 2}), (:A {x: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:A) RETURN n.x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 3 |
+
+  Scenario: Match a directed relationship
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {name: 'a'})-[:T]->(b:B {name: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x)-[:T]->(y) RETURN x.name AS x, y.name AS y
+      """
+    Then the result should be, in any order:
+      | x   | y   |
+      | 'a' | 'b' |
+
+  Scenario: Directed match does not match the reverse direction
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)
+      """
+    When executing query:
+      """
+      MATCH (x:B)-[:T]->(y:A) RETURN x, y
+      """
+    Then the result should be empty
+
+  Scenario: Undirected match returns both orientations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n: 1})-[:T]->(b:B {n: 2})
+      """
+    When executing query:
+      """
+      MATCH (x)-[:T]-(y) RETURN x.n AS x, y.n AS y
+      """
+    Then the result should be, in any order:
+      | x | y |
+      | 1 | 2 |
+      | 2 | 1 |
+
+  Scenario: Match a relationship and return it
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:T {w: 7}]->(:B)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:T]->() RETURN r
+      """
+    Then the result should be, in any order:
+      | r           |
+      | [:T {w: 7}] |
+
+  Scenario: Match by relationship type filters other types
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A), (b:B), (a)-[:T]->(b), (a)-[:U]->(b)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:T]->() RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+
+  Scenario: Match a two-hop pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:T]->(b:P {n: 'b'})-[:T]->(c:P {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (x)-[:T]->()-[:T]->(z) RETURN x.n AS x, z.n AS z
+      """
+    Then the result should be, in any order:
+      | x   | z   |
+      | 'a' | 'c' |
+
+  Scenario: Match a cyclic pattern binds the same node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n: 1}), (b:B {n: 2}), (a)-[:T]->(b), (b)-[:T]->(a), (a)-[:T]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[:T]->(x) RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+
+  Scenario: Match with inline property predicate
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'Alice', age: 30}), (:P {name: 'Bob', age: 40})
+      """
+    When executing query:
+      """
+      MATCH (p:P {name: 'Alice'}) RETURN p.age AS age
+      """
+    Then the result should be, in any order:
+      | age |
+      | 30  |
+
+  Scenario: Match two disconnected patterns yields the cross product
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {x: 1}), (:A {x: 2}), (:B {y: 10})
+      """
+    When executing query:
+      """
+      MATCH (a:A), (b:B) RETURN a.x AS x, b.y AS y
+      """
+    Then the result should be, in any order:
+      | x | y  |
+      | 1 | 10 |
+      | 2 | 10 |
+
+  Scenario: Relationship uniqueness within a pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:T]->(y)<-[r2:T]-(z) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+
+  Scenario: Multiple labels in the pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {x: 1}), (:A {x: 2}), (:B {x: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:A:B) RETURN n.x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |

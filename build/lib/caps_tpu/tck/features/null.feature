@@ -1,0 +1,97 @@
+Feature: Null semantics
+
+  Scenario: null equality is null and filters the row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'}), (:P {n: 'b', x: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.x = p.x RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+
+  Scenario: null inequality also filters
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.x <> 1 RETURN p.n AS n
+      """
+    Then the result should be empty
+
+  Scenario: arithmetic with null is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.x + 1 AS a, p.x * 2 AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: three-valued OR short-circuits through null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a', keep: true}), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.keep OR p.missing = 1 RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: three-valued AND with a false operand is false not null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a', f: false})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE NOT (p.f AND p.missing = 1) RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: IN with null element yields null when no match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 9})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.x IN [1, p.missing] RETURN p.x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+
+  Scenario: returning a missing property yields null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.nope AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |

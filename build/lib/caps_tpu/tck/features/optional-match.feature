@@ -1,0 +1,58 @@
+Feature: Optional match
+
+  Scenario: OPTIONAL MATCH pads non-matching rows with null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (a)-[:T]->(b)
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:T]->(q) RETURN p.n AS p, q.n AS q
+      """
+    Then the result should be, in any order:
+      | p   | q    |
+      | 'a' | 'b'  |
+      | 'b' | null |
+
+  Scenario: OPTIONAL MATCH that never matches returns all nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:MISSING]->(q) RETURN p.n AS p, q AS q
+      """
+    Then the result should be, in any order:
+      | p   | q    |
+      | 'a' | null |
+
+  Scenario: OPTIONAL MATCH with WHERE folds the predicate into the match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:Q {v: 1}), (c:Q {v: 2}), (a)-[:T]->(b), (a)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:T]->(q:Q) WHERE q.v > 1 RETURN p.n AS p, q.v AS v
+      """
+    Then the result should be, in any order:
+      | p   | v |
+      | 'a' | 2 |
+
+  Scenario: properties of an unmatched optional variable are null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'solo'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:T]->(q) RETURN p.n AS p, q.n AS qn, q IS NULL AS missing
+      """
+    Then the result should be, in any order:
+      | p      | qn   | missing |
+      | 'solo' | null | true    |

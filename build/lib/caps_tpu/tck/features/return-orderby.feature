@@ -1,0 +1,142 @@
+Feature: Return and order
+
+  Scenario: Return a literal from a unit query
+    Given an empty graph
+    When executing query:
+      """
+      RETURN 1 AS one
+      """
+    Then the result should be, in any order:
+      | one |
+      | 1   |
+
+  Scenario: Return an arithmetic expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.x * 2 + 1 AS y, p.x / 2.0 AS half
+      """
+    Then the result should be, in any order:
+      | y | half |
+      | 7 | 1.5  |
+
+  Scenario: RETURN DISTINCT removes duplicate rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 1}), (:P {x: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN DISTINCT p.x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 2 |
+
+  Scenario: ORDER BY ascending
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 3}), (:P {x: 1}), (:P {x: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.x AS x ORDER BY x
+      """
+    Then the result should be, in order:
+      | x |
+      | 1 |
+      | 2 |
+      | 3 |
+
+  Scenario: ORDER BY descending with LIMIT
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 3}), (:P {x: 1}), (:P {x: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.x AS x ORDER BY x DESC LIMIT 2
+      """
+    Then the result should be, in order:
+      | x |
+      | 3 |
+      | 2 |
+
+  Scenario: SKIP and LIMIT paginate an ordered result
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 2}), (:P {x: 3}), (:P {x: 4})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.x AS x ORDER BY x SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | x |
+      | 2 |
+      | 3 |
+
+  Scenario: ORDER BY two keys
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 'y'}), (:P {a: 1, b: 'x'}), (:P {a: 0, b: 'z'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.a AS a, p.b AS b ORDER BY a, b
+      """
+    Then the result should be, in order:
+      | a | b   |
+      | 0 | 'z' |
+      | 1 | 'x' |
+      | 1 | 'y' |
+
+  Scenario: ORDER BY an expression not in the projection
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a', x: 2}), (:P {n: 'b', x: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.n AS n ORDER BY p.x
+      """
+    Then the result should be, in order:
+      | n   |
+      | 'b' |
+      | 'a' |
+
+  Scenario: Return a list literal and a map literal
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [1, 2, 3] AS l, {a: 1, b: 'two'} AS m
+      """
+    Then the result should be, in any order:
+      | l         | m               |
+      | [1, 2, 3] | {a: 1, b: 'two'} |
+
+  Scenario: WITH chains projections
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {x: 1}), (:P {x: 2}), (:P {x: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p.x AS x WHERE x > 1 RETURN x * 10 AS y
+      """
+    Then the result should be, in any order:
+      | y  |
+      | 20 |
+      | 30 |

@@ -1,0 +1,89 @@
+Feature: Unwind and union
+
+  Scenario: UNWIND a list literal
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS x RETURN x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 2 |
+      | 3 |
+
+  Scenario: UNWIND an empty list produces no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [] AS x RETURN x
+      """
+    Then the result should be empty
+
+  Scenario: UNWIND preserves other bindings
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'}), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) UNWIND [1, 2] AS x RETURN p.n AS n, x
+      """
+    Then the result should be, in any order:
+      | n   | x |
+      | 'a' | 1 |
+      | 'a' | 2 |
+      | 'b' | 1 |
+      | 'b' | 2 |
+
+  Scenario: UNWIND a parameter list
+    Given an empty graph
+    And parameters are:
+      | xs | [10, 20] |
+    When executing query:
+      """
+      UNWIND $xs AS x RETURN x * 2 AS y
+      """
+    Then the result should be, in any order:
+      | y  |
+      | 20 |
+      | 40 |
+
+  Scenario: UNION removes duplicate rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {x: 1}), (:B {x: 1}), (:B {x: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN a.x AS x UNION MATCH (b:B) RETURN b.x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 2 |
+
+  Scenario: UNION ALL keeps duplicate rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {x: 1}), (:B {x: 1})
+      """
+    When executing query:
+      """
+      MATCH (a:A) RETURN a.x AS x UNION ALL MATCH (b:B) RETURN b.x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 1 |
+
+  Scenario: UNION with different return columns is an error
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (a) RETURN a UNION MATCH (b) RETURN b
+      """
+    Then a SyntaxError should be raised at compile time: DifferentColumnsInUnion

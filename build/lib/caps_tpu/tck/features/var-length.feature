@@ -1,0 +1,117 @@
+Feature: Var-length expand
+
+  Scenario: fixed range variable expansion
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:T]->(b:P {n: 'b'})-[:T]->(c:P {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:T*1..2]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+      | 'c' |
+
+  Scenario: zero-length expansion includes the start node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:T]->(b:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:T*0..1]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+      | 'b' |
+
+  Scenario: relationship uniqueness prevents re-walking an edge
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (a)-[:T]->(b), (b)-[:T]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:T*1..3]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+      | 'a' |
+
+  Scenario: exact length expansion
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:T]->(b:P {n: 'b'})-[:T]->(c:P {n: 'c'})-[:T]->(d:P {n: 'd'})
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:T*3]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'd' |
+
+  Scenario: uniqueness between a fixed and a var-length relationship
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:T]->(b:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (a)-[r:T]-(b)-[:T*1..1]-(c) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+
+  Scenario: uniqueness between two var-length relationships
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:T]->(b:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (a)-[:T*1..1]-(b)-[:T*1..1]-(c) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+
+  Scenario: two var-length expansions over distinct edges both match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:T]->(b:P {n: 'b'})-[:T]->(c:P {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:T*1..1]->(y)-[:T*1..1]->(z) RETURN z.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'c' |
+
+  Scenario: undirected variable expansion
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (c:P {n: 'c'}), (b)-[:T]->(a), (b)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:T*1..2]-(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+      | 'c' |

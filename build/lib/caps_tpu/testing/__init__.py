@@ -1,0 +1,7 @@
+"""Test harness: Bag comparison and the CREATE-string graph factory.
+
+Mirrors the reference's ``okapi-testing`` assets — ``Bag`` multiset
+comparison and ``CreateGraphFactory`` (ref: okapi-testing/ — reconstructed,
+mount empty; SURVEY.md §2, §4).
+"""
+from caps_tpu.testing.bag import Bag  # noqa: F401

@@ -1,0 +1,48 @@
+"""Test-only fault injection for the device backend.
+
+SURVEY.md §5.3: the reference inherits failure detection from Spark
+(lineage re-execution, executor blacklisting) and ships no fault-injection
+tests of its own; single-controller JAX has no task retry, so our
+equivalent machinery is (a) deterministic replay + digest comparison
+(``EngineConfig.determinism_check`` / ``result_digest``) and (b) this
+module: a context manager that corrupts one shard's buffers on ingest so
+tests can prove the detection machinery actually notices damage.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def corrupt_shard(session, shard: int = 0, flip_bits: int = 1):
+    """While active, every *data* buffer placed on the backend's mesh gets
+    ``flip_bits`` added to the rows landing on ``shard`` (validity masks
+    are left intact — the corruption is silent, like real bit damage).
+    Only affects tables ingested inside the ``with`` block."""
+    backend = session.backend
+    if backend.mesh is None:
+        raise ValueError("corrupt_shard needs a sharded session "
+                         "(EngineConfig.mesh_shape)")
+    n_shards = backend.mesh.devices.size
+    orig = backend.place_column
+
+    def poisoned(col):
+        n = col.data.shape[0]
+        if n % n_shards == 0 and col.data.dtype != jnp.bool_:
+            rows = n // n_shards
+            lo, hi = shard * rows, (shard + 1) * rows
+            idx = jnp.arange(n)
+            in_shard = (idx >= lo) & (idx < hi)
+            bump = jnp.asarray(flip_bits, col.data.dtype)
+            col = type(col)(col.kind,
+                            jnp.where(in_shard, col.data + bump, col.data),
+                            col.valid, col.ctype, col.lens)
+        return orig(col)
+
+    backend.place_column = poisoned
+    try:
+        yield
+    finally:
+        backend.place_column = orig

@@ -1,0 +1,23 @@
+"""Backend session factory shared by the unit and acceptance suites
+(mirrors the reference's one shared SparkSession fixture per backend —
+ref: spark-cypher-testing CAPSTestSuite/SparkSessionFixture, reconstructed,
+mount empty; SURVEY.md §4)."""
+from __future__ import annotations
+
+BACKENDS = ["local", "tpu", "sharded"]
+
+
+def make_backend_session(backend: str):
+    if backend == "local":
+        from caps_tpu.backends.local.session import LocalCypherSession
+        return LocalCypherSession()
+    if backend == "tpu":
+        from caps_tpu.backends.tpu.session import TPUCypherSession
+        return TPUCypherSession()
+    if backend == "sharded":
+        # same device backend over an 8-way mesh (virtual CPU devices in
+        # the unit suite — SURVEY.md §4 carry-over (c): mesh size is config)
+        from caps_tpu.backends.tpu.session import TPUCypherSession
+        from caps_tpu.okapi.config import EngineConfig
+        return TPUCypherSession(config=EngineConfig(mesh_shape=(8,)))
+    raise ValueError(backend)

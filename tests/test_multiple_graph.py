@@ -105,7 +105,7 @@ def test_return_graph_of_from_graph(session):
     assert rows == [{"v": 7}]
 
 
-@pytest.mark.parametrize("fmt", ["parquet", "csv"])
+@pytest.mark.parametrize("fmt", ["parquet", "csv", "orc"])
 def test_fs_roundtrip(session, tmp_path, fmt):
     src = FSGraphSource(session, str(tmp_path), fmt=fmt)
     session.catalog.register_source(Namespace("fs"), src)
@@ -165,7 +165,7 @@ def test_construct_on_set_clone_replaces_original(session):
     assert rels == [{"c": 1}]
 
 
-@pytest.mark.parametrize("fmt", ["parquet", "csv"])
+@pytest.mark.parametrize("fmt", ["parquet", "csv", "orc"])
 def test_fs_roundtrip_label_with_underscore(session, tmp_path, fmt):
     src = FSGraphSource(session, str(tmp_path), fmt=fmt)
     session.catalog.register_source(Namespace("fsu"), src)
@@ -180,6 +180,18 @@ def test_fs_roundtrip_label_with_underscore(session, tmp_path, fmt):
         "MATCH (:My_Label)-[r:HAS_PART]->(m) RETURN m.v AS v"
         ).records.to_maps()
     assert rels == [{"v": 2}]
+
+
+def test_fs_orc_all_null_property(session, tmp_path):
+    """ORC has no null type: an all-null property column must still
+    round-trip (stored as null strings)."""
+    src = FSGraphSource(session, str(tmp_path), fmt="orc")
+    session.catalog.register_source(Namespace("fso"), src)
+    g = create_graph(session, "CREATE (:P {x: 1}), (:P)")
+    session.catalog.store("fso.g", g)
+    loaded = session.catalog.graph("fso.g")
+    rows = loaded.cypher("MATCH (n:P) RETURN n.x AS x").records.to_maps()
+    assert Bag(rows) == [{"x": 1}, {"x": None}]
 
 
 def test_fs_no_combo_collision(session, tmp_path):

@@ -1,4 +1,4 @@
-"""Native host runtime (csrc/host_runtime.cpp): differential tests of the
+"""Native host runtime (native/csrc/host_runtime.cpp): differential tests of the
 C++ string pool / ingest / CSR against the pure-Python implementations
 (SURVEY.md §2 native components — each native path keeps a Python twin)."""
 import numpy as np
