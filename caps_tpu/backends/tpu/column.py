@@ -210,8 +210,16 @@ def _make_column_native(values, kind: str, n: int):
 
 
 def column_to_host(col: Column, n: int, pool) -> List[Any]:
-    """Device column → host Python values (None for null)."""
-    valid = np.asarray(col.valid[:n])
+    """Device column → host Python values (None for null).
+
+    Each device→host read is a full transport round trip (on remote
+    transports ~tens of ms flat), so columns whose validity is host-known
+    (e.g. the fused count result) carry a numpy ``valid`` and pay exactly
+    ONE device read here."""
+    if isinstance(col.valid, np.ndarray):
+        valid = col.valid[:n]
+    else:
+        valid = np.asarray(col.valid[:n])
     if col.kind == "list":
         ek = list_elem_kind(col.ctype) or "id"
         data = np.asarray(col.data[:n])

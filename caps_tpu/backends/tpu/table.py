@@ -49,9 +49,24 @@ class DeviceBackend:
     schedule ICI traffic better than the partitioner.
     """
 
+    _persistent_cache_dir: Optional[str] = None
+
     def __init__(self, config: EngineConfig):
         self.pool = make_pool()
         self.config = config
+        if config.compile_cache_dir and \
+                DeviceBackend._persistent_cache_dir != config.compile_cache_dir:
+            # Persistent XLA compilation cache: repeat processes reuse
+            # compiled executables (jax only persists entries whose compile
+            # time exceeds its threshold, so tiny test programs skip it).
+            # jax_compilation_cache_dir is process-global; the last
+            # explicitly-configured directory wins.
+            try:
+                jax.config.update("jax_compilation_cache_dir",
+                                  config.compile_cache_dir)
+                DeviceBackend._persistent_cache_dir = config.compile_cache_dir
+            except Exception:
+                pass
         self.fallbacks = 0
         self.fallback_reasons: List[str] = []
         self.syncs = 0  # device->host scalar materializations (perf metric)
@@ -61,6 +76,11 @@ class DeviceBackend:
         # ("replay", sizes, [i])  = serve sizes from the memo, NO syncs —
         # the whole query stays async / traceable.
         self.count_mode: Optional[tuple] = None
+        # Single-program count-pushdown caches (relational/count_pattern.py):
+        # per-graph static structures (sorted edges/ids, segment boundary
+        # gathers, id domain) and per-(graph, plan, params) jitted closures.
+        self.fused_count_static: Dict[int, dict] = {}
+        self.fused_count_fns: Dict[tuple, tuple] = {}
         self.mesh = None
         self.axis = config.mesh_axis
         if config.mesh_shape:
@@ -816,6 +836,16 @@ class DeviceTable(Table):
         if self._local is not None:
             return self._local.column_values(col)
         return column_to_host(self._cols[col], self._n, self.backend.pool)
+
+    def device_column(self, col: str):
+        """(data, valid, live_row_count) without host materialization —
+        the async result surface: callers can keep results on device and
+        batch their transfers (each device→host read is a full transport
+        round trip)."""
+        if self._local is not None:
+            raise UnsupportedOnDevice("table is in host-fallback mode")
+        c = self._cols[col]
+        return c.data, c.valid, self._n
 
 
 @jax.jit

@@ -64,9 +64,21 @@ class EngineConfig:
     # on a query's first run, replay them sync-free on repeats.
     use_fused: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_FUSED", True))
+    # Single-program count pushdown (relational/count_pattern.py): compile
+    # the whole seed→hops→masks→correction chain into ONE scatter-free
+    # jitted program, cached per (graph, plan shape, params).
+    use_fused_count: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_FUSED_COUNT", True))
     # Compile-cache capacity (query programs keyed by plan+bucket shapes)
     compile_cache_size: int = dataclasses.field(
         default_factory=lambda: _env_int("CAPS_TPU_COMPILE_CACHE", 512))
+    # Persistent XLA compilation cache directory ("" = disabled).  Repeat
+    # processes skip device compiles entirely — on remote-compile
+    # transports this turns a ~100 s cold start into seconds.
+    compile_cache_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "CAPS_TPU_COMPILE_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "caps_tpu_xla")))
     # Determinism check (SURVEY.md §5.2): run each query twice and compare
     # result digests; raises NondeterministicResultError on mismatch.
     determinism_check: bool = dataclasses.field(

@@ -47,6 +47,17 @@ from caps_tpu.relational.var_expand import synth_header
 # Node-id domains larger than this refuse the dense-vector form.
 _MAX_DOMAIN = 1 << 26
 
+# Sentinel: the length-2 correction has no device path (vs None = the
+# correction is provably zero).
+_UNSUITABLE_CORR = object()
+
+# Negative fused-closure cache entry: this (graph, plan, params) shape is
+# known unfusable — don't re-probe on every execution.
+_NO_FUSE = object()
+
+# Per-graph static structures kept at most for this many distinct graphs.
+_MAX_STATIC_GRAPHS = 16
+
 
 @dataclasses.dataclass(frozen=True)
 class NodeSpec:
@@ -78,6 +89,28 @@ def _split(pred: E.Expr) -> Tuple[E.Expr, ...]:
             out.extend(_split(p))
         return tuple(out)
     return (pred,)
+
+
+def _corr_intersection(h1: "HopSpec", h2: "HopSpec"):
+    """Edge scan an r2==r1 reuse can live in: the intersection of both
+    hops' type constraints (an untyped hop matches every type).  Returns
+    the type set, or None when provably disjoint (zero correction)."""
+    ta, tb = set(h1.rel_types), set(h2.rel_types)
+    if not ta:
+        return tb
+    if not tb:
+        return ta
+    inter = ta & tb
+    return inter or None
+
+
+def _corr_roles(h1: "HopSpec", h2: "HopSpec", src, tgt):
+    """Per-edge index roles for the length-2 correction, resolved by hop
+    directions: (a, b) = hop-1 (from, to), (near2, far2) = hop-2."""
+    a, b = (src, tgt) if h1.direction == Direction.OUTGOING else (tgt, src)
+    near2, far2 = (src, tgt) if h2.direction == Direction.OUTGOING \
+        else (tgt, src)
+    return a, b, near2, far2
 
 
 def _as_uniqueness_pair(pred: E.Expr) -> Opt[Tuple[str, str]]:
@@ -256,6 +289,392 @@ class CountPatternOp(RelationalOperator):
         self._metric_extra = {"strategy": self.strategy}
         return out
 
+    # -- fused single-program execution -------------------------------------
+    #
+    # The whole seed→hops→masks→correction chain compiles to ONE jitted,
+    # scatter-free program (the engine's whole-stage-codegen for the count
+    # path — ref analog: Spark Tungsten codegen, SparkTable.scala†,
+    # SURVEY.md §3.1 invariant "one compiled program per plan").  All
+    # data-dependent structure is hoisted out of the steady state:
+    #
+    #   * per GRAPH (immutable): edge lists sorted by destination, node-scan
+    #     ids sorted, and the per-node segment boundary gathers (`ends`)
+    #     that turn segment-sum into cumsum + two gathers — no XLA
+    #     scatter-add (which serializes on TPU) anywhere;
+    #   * per (graph, plan shape, params): node-predicate masks are
+    #     evaluated once (they are pure functions of graph data + params)
+    #     and the whole chain is traced into one jax.jit closure;
+    #   * per ITERATION: one program dispatch, zero host syncs.
+
+    def _fused_total(self):
+        backend = getattr(self.context.factory, "backend", None)
+        if backend is None or backend.mesh is not None:
+            return None
+        if not backend.config.use_fused_count:
+            return None
+        from caps_tpu.backends.tpu.fused import _graph_key, _params_key
+        gk = _graph_key(self.graph)
+        pk = _params_key(self.context.parameters)
+        if gk is None or pk is None:
+            return None
+        key = (gk, pk, len(backend.pool), self._plan_sig())
+        entry = backend.fused_count_fns.get(key)
+        if entry is _NO_FUSE:
+            return None
+        if entry is None:
+            # Build outside any record/replay scope: the one-time scan and
+            # sort syncs must not leak into a fused-executor recording (a
+            # replay would never repeat them).
+            saved = backend.count_mode
+            backend.count_mode = None
+            try:
+                entry = self._build_fused(backend, gk)
+            finally:
+                backend.count_mode = saved
+            fns = backend.fused_count_fns
+            while len(fns) >= max(1, backend.config.compile_cache_size):
+                fns.pop(next(iter(fns)))
+            # negative results are cached too: repeats of an unfusable
+            # query must not pay the build probing (and its host syncs)
+            # every execution
+            fns[key] = _NO_FUSE if entry is None else entry
+            if entry is None:
+                return None
+        fn, args, valid = entry
+        self.strategy = "fused-spmv"
+        return fn(*args), valid
+
+    def _plan_sig(self):
+        def nsig(s: NodeSpec):
+            return (tuple(sorted(s.labels)), tuple(repr(p) for p in s.preds))
+        return (nsig(self.seed),
+                tuple((tuple(sorted(set(h.rel_types))), h.direction,
+                       nsig(h.target)) for h in self.hops),
+                tuple(self.lengths), self.is_varlen, self.correct_len2)
+
+    def _graph_static(self, backend, gk) -> dict:
+        st = backend.fused_count_static.get(gk)
+        if st is None:
+            # Evict oldest graphs so discarded graphs' device-resident
+            # sorted-edge copies don't pin memory for the process lifetime.
+            # Their closures must go with them (closures capture the
+            # arrays; a stale closure would also serve a reused epoch).
+            while len(backend.fused_count_static) >= _MAX_STATIC_GRAPHS:
+                old = next(iter(backend.fused_count_static))
+                backend.fused_count_static.pop(old)
+                for k in [k for k in backend.fused_count_fns if k[0] == old]:
+                    backend.fused_count_fns.pop(k)
+            st = {"scans": {}, "rels": {}, "edges": {}, "ids": {}}
+            backend.fused_count_static[gk] = st
+        return st
+
+    def _fused_scan(self, st, labels: frozenset):
+        """(header, table, ids, static_ok) for a node scan, pure-device
+        only; cached per graph."""
+        key = ("node", labels)
+        if key in st["scans"]:
+            return st["scans"][key]
+        from caps_tpu.backends.tpu.table import DeviceTable
+        header, t = self.graph.scan_node("__cnt_n", labels)
+        entry = None
+        if isinstance(t, DeviceTable) and not t.is_local and t.capacity:
+            c = t._cols[header.column(E.Var("__cnt_n"))]
+            if c.kind in ("id", "int"):
+                entry = (header, t, c.data, c.valid & t.row_ok)
+        st["scans"][key] = entry
+        return entry
+
+    def _fused_rel(self, st, rk: Tuple[str, ...]):
+        """(src, tgt, ok) device arrays for a relationship scan; cached."""
+        if rk in st["rels"]:
+            return st["rels"][rk]
+        from caps_tpu.backends.tpu.table import DeviceTable
+        header, t = self.graph.scan_rel("__cnt_r", rk)
+        entry = None
+        if isinstance(t, DeviceTable) and not t.is_local:
+            v = E.Var("__cnt_r")
+            s = t._cols[header.column(E.StartNode(v))]
+            g = t._cols[header.column(E.EndNode(v))]
+            if s.kind in ("id", "int") and g.kind in ("id", "int"):
+                entry = (s.data, g.data,
+                         s.valid & g.valid & t.row_ok)
+        st["rels"][rk] = entry
+        return entry
+
+    def _fused_edges(self, st, rk, direction, n: int):
+        """Edges of one hop sorted by destination + per-node segment
+        boundaries: (frm_sorted, ok_sorted, ends)."""
+        import jax.numpy as jnp
+        key = (rk, direction, n)
+        if key in st["edges"]:
+            return st["edges"][key]
+        rel = self._fused_rel(st, rk)
+        if rel is None:
+            st["edges"][key] = None
+            return None
+        src, tgt, ok = rel
+        frm, to = (src, tgt) if direction == Direction.OUTGOING else (tgt, src)
+        to_fold = jnp.where(ok, to, n).astype(jnp.int32)
+        order = jnp.argsort(to_fold)
+        to_sorted = to_fold[order]
+        frm_sorted = jnp.where(ok, frm, 0).astype(jnp.int32)[order]
+        ok_sorted = ok[order]
+        ends = (jnp.searchsorted(to_sorted, jnp.arange(n, dtype=jnp.int32),
+                                 side="right") - 1).astype(jnp.int32)
+        # clipped destination for edgewise mask gathers on the final hop
+        # (invalid edges carry the n sentinel; ok_sorted already excludes
+        # them, the clip just keeps the gather in bounds)
+        to_clip = jnp.minimum(to_sorted, n - 1)
+        entry = (frm_sorted, ok_sorted, ends, to_clip)
+        st["edges"][key] = entry
+        return entry
+
+    def _fused_ids(self, st, labels: frozenset, n: int):
+        """Node-scan ids sorted + segment boundaries: (order, ends)."""
+        import jax.numpy as jnp
+        key = (labels, n)
+        if key in st["ids"]:
+            return st["ids"][key]
+        _, _, ids, static_ok = st["scans"][("node", labels)]
+        id_fold = jnp.where(static_ok, ids, n).astype(jnp.int32)
+        order = jnp.argsort(id_fold)
+        ids_sorted = id_fold[order]
+        ends = (jnp.searchsorted(ids_sorted, jnp.arange(n, dtype=jnp.int32),
+                                 side="right") - 1).astype(jnp.int32)
+        entry = (order, ends)
+        st["ids"][key] = entry
+        return entry
+
+    def _fused_okpred(self, scan, spec: NodeSpec, order):
+        """Predicate mask over a node scan, evaluated ONCE at closure-build
+        time (pure function of graph data + params), permuted into id
+        order.  Returns None if a predicate has no device path."""
+        from caps_tpu.backends.tpu.expr import (
+            DeviceExprCompiler, UnsupportedOnDevice,
+        )
+        from caps_tpu.relational.ops import resolve_expr
+        header, t, _ids, static_ok = scan
+        okpred = static_ok
+        if spec.preds:
+            backend = self.context.factory.backend
+            compiler = DeviceExprCompiler(t._cols, t.capacity, header,
+                                          self.context.parameters,
+                                          backend.pool, t.row_ok)
+
+            def rename(e: E.Expr) -> E.Expr:
+                # the cached scan binds "__cnt_n", not the query's var name
+                if isinstance(e, E.Var) and e.name == spec.var:
+                    return E.Var("__cnt_n")
+                return e
+
+            try:
+                for pred in spec.preds:
+                    renamed = pred.transform_up(rename)
+                    col = compiler.compile(resolve_expr(renamed, header))
+                    if col.kind != "bool":
+                        return None
+                    okpred = okpred & col.data & col.valid
+            except (UnsupportedOnDevice, KeyError):
+                return None
+        return okpred[order]
+
+    def _build_fused(self, backend, gk):
+        import jax
+        import jax.numpy as jnp
+        st = self._graph_static(backend, gk)
+
+        seed_scan = self._fused_scan(st, self.seed.labels)
+        if seed_scan is None:
+            return None
+        if self.is_varlen:
+            mask_specs = [self.hops[0].target]
+        else:
+            mask_specs = [h.target for h in self.hops]
+        mask_scans = [self._fused_scan(st, s.labels) for s in mask_specs]
+        if any(m is None for m in mask_scans):
+            return None
+        relkeys = [tuple(sorted(set(h.rel_types))) for h in self.hops]
+        rels = {rk: self._fused_rel(st, rk) for rk in relkeys}
+        if any(r is None for r in rels.values()):
+            return None
+
+        # id domain over everything this chain touches (one-time sync)
+        mx = jnp.int64(-1)
+        for _, _, ids, ok in [seed_scan] + mask_scans:
+            if ids.shape[0]:
+                mx = jnp.maximum(mx, jnp.max(jnp.where(
+                    ok, ids.astype(jnp.int64), -1)))
+        for src, tgt, ok in rels.values():
+            if src.shape[0]:
+                mx = jnp.maximum(mx, jnp.max(jnp.where(
+                    ok, src.astype(jnp.int64), -1)))
+                mx = jnp.maximum(mx, jnp.max(jnp.where(
+                    ok, tgt.astype(jnp.int64), -1)))
+        n = int(mx) + 1
+        if n <= 0:
+            n = 1
+        if n > _MAX_DOMAIN:
+            return None  # let the eager path raise _Unsuitable
+
+        seed_order, seed_ends = self._fused_ids(st, self.seed.labels, n)
+        seed_okps = self._fused_okpred(seed_scan, self.seed, seed_order)
+        if seed_okps is None:
+            return None
+        # Hops often share a target spec (e.g. two unlabeled nodes): build
+        # each distinct mask once and index into it, so the program carries
+        # no duplicate dense-vector subgraphs.
+        masks: List[tuple] = []
+        mask_index: List[int] = []
+        uniq: Dict[tuple, int] = {}
+        for spec, scan in zip(mask_specs, mask_scans):
+            k = (spec.labels, tuple(repr(p) for p in spec.preds))
+            if k not in uniq:
+                order, ends = self._fused_ids(st, spec.labels, n)
+                okps = self._fused_okpred(scan, spec, order)
+                if okps is None:
+                    return None
+                uniq[k] = len(masks)
+                masks.append((okps, ends))
+            mask_index.append(uniq[k])
+        mask_index = tuple(mask_index)
+        hop_edges = [self._fused_edges(st, rk, h.direction, n)
+                     for rk, h in zip(relkeys, self.hops)]
+        if any(e is None for e in hop_edges):
+            return None
+
+        correct = self.correct_len2 and 2 in self.lengths
+        corr = None
+        if correct:
+            corr = self._fused_corr(st, n)
+            if corr is _UNSUITABLE_CORR:
+                return None
+            if corr is not None:
+                corr = self._compact_corr(backend, corr)
+
+        lengths = tuple(self.lengths)
+        max_len = max(lengths)
+        is_varlen = self.is_varlen
+        cap1 = backend.bucket(1)
+
+        # Dtype schedule (gathers dominate the program on TPU — random
+        # gather cost scales with element width, so every gather is as
+        # narrow as correctness allows): node indicators are BOOL; the
+        # frontier after hop 1 is int32 (values bounded by in-degree < 2^31
+        # since edges are int32-indexed); hop 2+ frontiers are int64 (path
+        # counts compose multiplicatively).  The final hop never builds a
+        # dense frontier at all — it reduces edgewise with a bool mask
+        # gather at the destination.
+
+        def dense_bool(okps, ends):
+            """Node indicator from id-sorted membership: int32 cumsum +
+            one boundary gather — the scatter-free segment-sum."""
+            if okps.shape[0] == 0:
+                return jnp.zeros((n,), bool)
+            c = jnp.cumsum(okps.astype(jnp.int32))
+            cum = jnp.where(ends >= 0, c[jnp.clip(ends, 0, None)], 0)
+            prev = jnp.concatenate([jnp.zeros(1, jnp.int32), cum[:-1]])
+            return (cum - prev) > 0
+
+        def hop_dense(x, frm, ok, ends, out_dtype):
+            """One SpMV hop to a dense frontier of ``out_dtype``."""
+            if frm.shape[0] == 0:
+                return jnp.zeros((n,), out_dtype)
+            gx = x[frm]
+            if gx.dtype == jnp.bool_:
+                contrib = (ok & gx).astype(out_dtype)
+            else:
+                contrib = jnp.where(ok, gx, 0).astype(out_dtype)
+            c = jnp.cumsum(contrib)
+            cum = jnp.where(ends >= 0, c[jnp.clip(ends, 0, None)], 0)
+            prev = jnp.concatenate([jnp.zeros(1, c.dtype), cum[:-1]])
+            return cum - prev
+
+        def hop_edgewise(x, frm, ok, to_clip, emask):
+            """Final hop: Σ_e x[frm]·mask[to] — no dense rebuild."""
+            if frm.shape[0] == 0:
+                return jnp.int64(0)
+            keep = ok & emask[to_clip]
+            gx = x[frm]
+            if gx.dtype == jnp.bool_:
+                return (keep & gx).sum(dtype=jnp.int64)
+            return jnp.where(keep, gx, 0).sum(dtype=jnp.int64)
+
+        @jax.jit
+        def run(seed_okps, seed_ends, masks, hops, corr):
+            x0 = dense_bool(seed_okps, seed_ends)
+            uniq_vecs = [dense_bool(mo, me) for mo, me in masks]
+            mask_vecs = [uniq_vecs[i] for i in mask_index]
+            end_mask = mask_vecs[0] if is_varlen else mask_vecs[-1]
+            total = jnp.int64(0)
+            x = x0
+            for length in range(0, max_len + 1):
+                if length in lengths and length < max_len:
+                    xl = x.astype(jnp.int64)
+                    if is_varlen:
+                        xl = jnp.where(end_mask, xl, 0)
+                    total = total + xl.sum()
+                if length < max_len:
+                    frm, ok, ends, to_clip = hops[length]
+                    if length == max_len - 1 and max_len in lengths:
+                        emask = end_mask if is_varlen \
+                            else mask_vecs[length]
+                        total = total + hop_edgewise(x, frm, ok, to_clip,
+                                                     emask)
+                    else:
+                        dt = jnp.int32 if length == 0 else jnp.int64
+                        x = hop_dense(x, frm, ok, ends, dt)
+                        if not is_varlen:
+                            x = jnp.where(mask_vecs[length], x, 0)
+            if corr is not None:
+                cvalid, a, b, f = corr
+                hit = cvalid & x0[a]
+                if not is_varlen:
+                    hit = hit & mask_vecs[0][b]
+                hit = hit & (end_mask if is_varlen else mask_vecs[1])[f]
+                total = total - hit.sum(dtype=jnp.int64)
+            return jnp.zeros((cap1,), jnp.int64).at[0].set(total)
+
+        args = (seed_okps, seed_ends, tuple(masks), tuple(hop_edges), corr)
+        # Host-side validity: the count row is always valid, and a numpy
+        # mask lets result materialization skip one device round trip.
+        valid = np.ones((cap1,), bool)
+        return (run, args, valid)
+
+    def _compact_corr(self, backend, corr):
+        """The length-2 correction only involves edges whose reuse
+        condition holds — a static property of the graph — so compact to
+        that (usually tiny) subset once at build time."""
+        import jax.numpy as jnp
+        cond, a, b, f = corr
+        nc = int(cond.sum())  # one-time sync, outside record/replay
+        if nc == 0:
+            return None
+        cap_c = backend.bucket(nc)
+        (idx,) = jnp.nonzero(cond, size=cap_c, fill_value=0)
+        cvalid = (jnp.arange(cap_c) < nc) & cond[idx]
+        return (cvalid, a[idx], b[idx], f[idx])
+
+    def _fused_corr(self, st, n: int):
+        """Static per-edge data for the length-2 isomorphism correction:
+        (cond, a, b, far2) with indices pre-clipped.  None = zero
+        correction; _UNSUITABLE_CORR = no device path."""
+        import jax.numpy as jnp
+        h1, h2 = self.hops[0], self.hops[1]
+        inter = _corr_intersection(h1, h2)
+        if inter is None:
+            return None
+        rel = self._fused_rel(st, tuple(sorted(inter)))
+        if rel is None:
+            return _UNSUITABLE_CORR
+        src, tgt, ok = rel
+        if src.shape[0] == 0:
+            return None
+        a, b, near2, far2 = _corr_roles(h1, h2, src, tgt)
+        cond = ok & (near2 == b)
+        safe = lambda v: jnp.clip(jnp.where(cond, v, 0), 0, n - 1
+                                  ).astype(jnp.int32)
+        return (cond, safe(a), safe(b), safe(far2))
+
     def _domain(self, parts) -> int:
         """Smallest N covering every id seen (consume_count so fused
         replay serves it sync-free)."""
@@ -285,6 +704,10 @@ class CountPatternOp(RelationalOperator):
     def _compute_pushdown(self):
         import jax
         import jax.numpy as jnp
+
+        fused = self._fused_total()
+        if fused is not None:
+            return self._emit_fused(*fused)
 
         seed_ids, seed_ok = self._node_ids(self.seed)
         rel_cache: Dict[Tuple[str, ...], tuple] = {}
@@ -427,22 +850,13 @@ class CountPatternOp(RelationalOperator):
         determine (a, b, c) — making the lowering exact under
         relationship isomorphism for every type combination."""
         h1, h2 = self.hops[0], self.hops[1]
-        ta, tb = set(h1.rel_types), set(h2.rel_types)  # empty = all types
-        if not ta:
-            inter = tb
-        elif not tb:
-            inter = ta
-        else:
-            inter = ta & tb
-            if not inter:
-                return jnp.int64(0)  # disjoint scans: an edge can't repeat
+        inter = _corr_intersection(h1, h2)
+        if inter is None:
+            return jnp.int64(0)  # disjoint scans: an edge can't repeat
         (src, src_ok), (tgt, tgt_ok) = self._rel_arrays(
             tuple(sorted(inter)))
         ok = src_ok & tgt_ok
-        a, b = (src, tgt) if h1.direction == Direction.OUTGOING \
-            else (tgt, src)
-        near2, far2 = (src, tgt) if h2.direction == Direction.OUTGOING \
-            else (tgt, src)
+        a, b, near2, far2 = _corr_roles(h1, h2, src, tgt)
         cond = ok & (near2 == b)
         def mask_at(vec, ids):
             if vec is None:
@@ -456,6 +870,16 @@ class CountPatternOp(RelationalOperator):
             * mask_at(corr_masks[0], b) * mask_at(corr_masks[1], far2),
             0)
         return contrib.sum()
+
+    def _emit_fused(self, data, valid):
+        """Wrap the fused program's already-padded output column (no extra
+        device dispatches on the steady path)."""
+        header = RecordHeader([(E.Var(self.out_name), self.out_name,
+                                CTInteger)])
+        from caps_tpu.backends.tpu.table import Column, DeviceTable
+        col = Column("int", data, valid, CTInteger)
+        return header, DeviceTable(self.context.factory.backend,
+                                   {self.out_name: col}, 1)
 
     def _emit(self, total):
         import jax.numpy as jnp
