@@ -369,8 +369,11 @@ class CountPatternOp(RelationalOperator):
         return st
 
     def _fused_scan(self, st, labels: frozenset):
-        """(header, table, ids, static_ok) for a node scan, pure-device
-        only; cached per graph."""
+        """(header, table, ids, static_ok, host_ids, host_ok) for a node
+        scan, pure-device only; cached per graph.  The host copies (one
+        read each, one-time) feed the numpy-side static builds below —
+        on remote transports a handful of numpy sorts beats a dozen
+        round-tripping device programs."""
         key = ("node", labels)
         if key in st["scans"]:
             return st["scans"][key]
@@ -380,12 +383,16 @@ class CountPatternOp(RelationalOperator):
         if isinstance(t, DeviceTable) and not t.is_local and t.capacity:
             c = t._cols[header.column(E.Var("__cnt_n"))]
             if c.kind in ("id", "int"):
-                entry = (header, t, c.data, c.valid & t.row_ok)
+                static_ok = c.valid & t.row_ok
+                entry = (header, t, static_ok,
+                         np.asarray(c.data), np.asarray(static_ok))
         st["scans"][key] = entry
         return entry
 
     def _fused_rel(self, st, rk: Tuple[str, ...]):
-        """(src, tgt, ok) device arrays for a relationship scan; cached."""
+        """(src, tgt, ok) HOST numpy arrays for a relationship scan;
+        cached (the edge structures built from these are device-resident,
+        the raw scan itself is only needed host-side)."""
         if rk in st["rels"]:
             return st["rels"][rk]
         from caps_tpu.backends.tpu.table import DeviceTable
@@ -396,14 +403,15 @@ class CountPatternOp(RelationalOperator):
             s = t._cols[header.column(E.StartNode(v))]
             g = t._cols[header.column(E.EndNode(v))]
             if s.kind in ("id", "int") and g.kind in ("id", "int"):
-                entry = (s.data, g.data,
-                         s.valid & g.valid & t.row_ok)
+                entry = (np.asarray(s.data), np.asarray(g.data),
+                         np.asarray(s.valid & g.valid & t.row_ok))
         st["rels"][rk] = entry
         return entry
 
     def _fused_edges(self, st, rk, direction, n: int):
         """Edges of one hop sorted by destination + per-node segment
-        boundaries: (frm_sorted, ok_sorted, ends)."""
+        boundaries: (frm_sorted, ok_sorted, ends, to_clip) device arrays,
+        built host-side in numpy and uploaded once."""
         import jax.numpy as jnp
         key = (rk, direction, n)
         if key in st["edges"]:
@@ -414,34 +422,42 @@ class CountPatternOp(RelationalOperator):
             return None
         src, tgt, ok = rel
         frm, to = (src, tgt) if direction == Direction.OUTGOING else (tgt, src)
-        to_fold = jnp.where(ok, to, n).astype(jnp.int32)
-        order = jnp.argsort(to_fold)
+        to_fold = np.where(ok, to, n).astype(np.int32)
+        order = np.argsort(to_fold, kind="stable")
         to_sorted = to_fold[order]
-        frm_sorted = jnp.where(ok, frm, 0).astype(jnp.int32)[order]
+        frm_sorted = np.where(ok, frm, 0).astype(np.int32)[order]
         ok_sorted = ok[order]
-        ends = (jnp.searchsorted(to_sorted, jnp.arange(n, dtype=jnp.int32),
-                                 side="right") - 1).astype(jnp.int32)
+        ends = (np.searchsorted(to_sorted, np.arange(n, dtype=np.int32),
+                                side="right") - 1).astype(np.int32)
         # clipped destination for edgewise mask gathers on the final hop
         # (invalid edges carry the n sentinel; ok_sorted already excludes
         # them, the clip just keeps the gather in bounds)
-        to_clip = jnp.minimum(to_sorted, n - 1)
-        entry = (frm_sorted, ok_sorted, ends, to_clip)
+        to_clip = np.minimum(to_sorted, n - 1)
+        backend = self.context.factory.backend
+        # place_rows keeps mesh configs edge-sharded (no-op single-chip)
+        entry = (backend.place_rows(jnp.asarray(frm_sorted)),
+                 backend.place_rows(jnp.asarray(ok_sorted)),
+                 backend.place_rows(jnp.asarray(ends)),
+                 backend.place_rows(jnp.asarray(to_clip)))
         st["edges"][key] = entry
         return entry
 
     def _fused_ids(self, st, labels: frozenset, n: int):
-        """Node-scan ids sorted + segment boundaries: (order, ends)."""
+        """Node-scan ids sorted + segment boundaries: (order, ends) —
+        order stays host-side (it permutes the predicate mask at build
+        time), ends is uploaded for the fused program."""
         import jax.numpy as jnp
         key = (labels, n)
         if key in st["ids"]:
             return st["ids"][key]
-        _, _, ids, static_ok = st["scans"][("node", labels)]
-        id_fold = jnp.where(static_ok, ids, n).astype(jnp.int32)
-        order = jnp.argsort(id_fold)
+        _, _, _ok, host_ids, host_ok = st["scans"][("node", labels)]
+        id_fold = np.where(host_ok, host_ids, n).astype(np.int32)
+        order = np.argsort(id_fold, kind="stable")
         ids_sorted = id_fold[order]
-        ends = (jnp.searchsorted(ids_sorted, jnp.arange(n, dtype=jnp.int32),
-                                 side="right") - 1).astype(jnp.int32)
-        entry = (order, ends)
+        ends = (np.searchsorted(ids_sorted, np.arange(n, dtype=np.int32),
+                                side="right") - 1).astype(np.int32)
+        backend = self.context.factory.backend
+        entry = (order, backend.place_rows(jnp.asarray(ends)))
         st["ids"][key] = entry
         return entry
 
@@ -453,30 +469,34 @@ class CountPatternOp(RelationalOperator):
             DeviceExprCompiler, UnsupportedOnDevice,
         )
         from caps_tpu.relational.ops import resolve_expr
-        header, t, _ids, static_ok = scan
+        import jax.numpy as jnp
+        header, t, static_ok, _hids, host_ok = scan
+        backend = self.context.factory.backend
+        if not spec.preds:
+            # no device work: permute the static mask host-side, upload
+            # once (a numpy arg would re-transfer on every call)
+            return backend.place_rows(jnp.asarray(host_ok[order]))
+        compiler = DeviceExprCompiler(t._cols, t.capacity, header,
+                                      self.context.parameters,
+                                      backend.pool, t.row_ok)
+
+        def rename(e: E.Expr) -> E.Expr:
+            # the cached scan binds "__cnt_n", not the query's var name
+            if isinstance(e, E.Var) and e.name == spec.var:
+                return E.Var("__cnt_n")
+            return e
+
         okpred = static_ok
-        if spec.preds:
-            backend = self.context.factory.backend
-            compiler = DeviceExprCompiler(t._cols, t.capacity, header,
-                                          self.context.parameters,
-                                          backend.pool, t.row_ok)
-
-            def rename(e: E.Expr) -> E.Expr:
-                # the cached scan binds "__cnt_n", not the query's var name
-                if isinstance(e, E.Var) and e.name == spec.var:
-                    return E.Var("__cnt_n")
-                return e
-
-            try:
-                for pred in spec.preds:
-                    renamed = pred.transform_up(rename)
-                    col = compiler.compile(resolve_expr(renamed, header))
-                    if col.kind != "bool":
-                        return None
-                    okpred = okpred & col.data & col.valid
-            except (UnsupportedOnDevice, KeyError):
-                return None
-        return okpred[order]
+        try:
+            for pred in spec.preds:
+                renamed = pred.transform_up(rename)
+                col = compiler.compile(resolve_expr(renamed, header))
+                if col.kind != "bool":
+                    return None
+                okpred = okpred & col.data & col.valid
+        except (UnsupportedOnDevice, KeyError):
+            return None
+        return backend.place_rows(okpred[order])
 
     def _build_fused(self, backend, gk):
         import jax
@@ -498,19 +518,16 @@ class CountPatternOp(RelationalOperator):
         if any(r is None for r in rels.values()):
             return None
 
-        # id domain over everything this chain touches (one-time sync)
-        mx = jnp.int64(-1)
-        for _, _, ids, ok in [seed_scan] + mask_scans:
-            if ids.shape[0]:
-                mx = jnp.maximum(mx, jnp.max(jnp.where(
-                    ok, ids.astype(jnp.int64), -1)))
+        # id domain over everything this chain touches (host-side — the
+        # scan host copies were read once when cached)
+        mx = -1
+        for _, _, _ok, host_ids, host_ok in [seed_scan] + mask_scans:
+            if host_ids.shape[0] and host_ok.any():
+                mx = max(mx, int(host_ids[host_ok].max()))
         for src, tgt, ok in rels.values():
-            if src.shape[0]:
-                mx = jnp.maximum(mx, jnp.max(jnp.where(
-                    ok, src.astype(jnp.int64), -1)))
-                mx = jnp.maximum(mx, jnp.max(jnp.where(
-                    ok, tgt.astype(jnp.int64), -1)))
-        n = int(mx) + 1
+            if src.shape[0] and ok.any():
+                mx = max(mx, int(src[ok].max()), int(tgt[ok].max()))
+        n = mx + 1
         if n <= 0:
             n = 1
         if n > _MAX_DOMAIN:
@@ -643,22 +660,26 @@ class CountPatternOp(RelationalOperator):
     def _compact_corr(self, backend, corr):
         """The length-2 correction only involves edges whose reuse
         condition holds — a static property of the graph — so compact to
-        that (usually tiny) subset once at build time."""
+        that (usually tiny) subset host-side at build time."""
         import jax.numpy as jnp
         cond, a, b, f = corr
-        nc = int(cond.sum())  # one-time sync, outside record/replay
+        (idx,) = np.nonzero(cond)
+        nc = len(idx)
         if nc == 0:
             return None
         cap_c = backend.bucket(nc)
-        (idx,) = jnp.nonzero(cond, size=cap_c, fill_value=0)
-        cvalid = (jnp.arange(cap_c) < nc) & cond[idx]
-        return (cvalid, a[idx], b[idx], f[idx])
+        pad = np.zeros(cap_c - nc, dtype=idx.dtype)
+        idx = np.concatenate([idx, pad])
+        cvalid = np.arange(cap_c) < nc
+        return (backend.place_rows(jnp.asarray(cvalid)),
+                backend.place_rows(jnp.asarray(a[idx])),
+                backend.place_rows(jnp.asarray(b[idx])),
+                backend.place_rows(jnp.asarray(f[idx])))
 
     def _fused_corr(self, st, n: int):
         """Static per-edge data for the length-2 isomorphism correction:
         (cond, a, b, far2) with indices pre-clipped.  None = zero
         correction; _UNSUITABLE_CORR = no device path."""
-        import jax.numpy as jnp
         h1, h2 = self.hops[0], self.hops[1]
         inter = _corr_intersection(h1, h2)
         if inter is None:
@@ -671,8 +692,8 @@ class CountPatternOp(RelationalOperator):
             return None
         a, b, near2, far2 = _corr_roles(h1, h2, src, tgt)
         cond = ok & (near2 == b)
-        safe = lambda v: jnp.clip(jnp.where(cond, v, 0), 0, n - 1
-                                  ).astype(jnp.int32)
+        safe = lambda v: np.clip(np.where(cond, v, 0), 0, n - 1
+                                 ).astype(np.int32)
         return (cond, safe(a), safe(b), safe(far2))
 
     def _domain(self, parts) -> int:
