@@ -20,10 +20,11 @@ dimensional-collapse / TrieJax line in PAPERS.md.)
 
 Correctness scope: openCypher matches with *relationship isomorphism* —
 the IR builder emits ``Not(id(r_i) = id(r_j))`` filters between hops —
-while SpMV counts walks.  For chains of ≤ 2 hops the difference is a
-closed-form correction (the only way a 2-hop walk reuses its edge is
-r2 == r1, detectable per edge), so the lowering is *exact* there and the
-matcher refuses longer chains, leaving them on the join path.
+while SpMV counts walks.  For chains of ≤ 3 hops the difference is a
+closed-form correction: 2-hop reuse is r2 == r1, detectable per edge;
+3-hop reuse is an inclusion–exclusion over the pairs (see _build_corr3).
+The lowering is *exact* there and the matcher refuses longer chains,
+leaving them on the join path.
 
 On a device mesh the chain runs sharded: uniform unmasked chains ride
 the ppermute ring schedule (parallel/ring.py); general chains use
@@ -124,8 +125,8 @@ def _as_uniqueness_pair(pred: E.Expr) -> Opt[Tuple[str, str]]:
 
 
 def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
-    """Match Aggregate(count(*)) over a 1-2 hop Expand chain (or a
-    var-length expand with upper <= 2) rooted at one NodeScan, and return
+    """Match Aggregate(count(*)) over a 1-3 hop Expand chain (or a
+    var-length expand with upper <= 3) rooted at one NodeScan, and return
     a CountPatternOp, or None if the shape doesn't qualify."""
     session = planner.context.session
     config = getattr(session, "config", None)
@@ -159,7 +160,7 @@ def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
             cur = cur.parent
         elif isinstance(cur, L.BoundedVarLengthExpand):
             if (cur.into or cur.direction == Direction.BOTH or hops_rev
-                    or varlen or cur.upper is None or cur.upper > 2):
+                    or varlen or cur.upper is None or cur.upper > 3):
                 return None
             varlen = cur
             cur = cur.parent
@@ -176,7 +177,7 @@ def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
         max_len = varlen.upper
         lengths = list(range(varlen.lower, varlen.upper + 1))
     else:
-        if not 1 <= len(hops_rev) <= 2:
+        if not 1 <= len(hops_rev) <= 3:
             return None
         node_vars = {seed[0]} | {h[3] for h in hops_rev}
         rel_vars = {h[0] for h in hops_rev}
@@ -206,22 +207,28 @@ def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
     if varlen is not None:
         # VarExpand joins the target node scan only where a path *ends*;
         # intermediate frontier nodes need no node row (engine semantics —
-        # see VarExpandOp).  It always enforces edge isomorphism.
+        # see VarExpandOp).  It always enforces edge isomorphism between
+        # every pair of hop positions.
         hop = HopSpec(varlen.rel, tuple(varlen.rel_types), varlen.direction,
                       node_spec(varlen.target, varlen.target_labels))
         hops = [hop] * max_len
-        correct_len2 = max_len == 2
+        uniq_pos = frozenset((i, j) for i in range(1, max_len + 1)
+                             for j in range(i + 1, max_len + 1))
     else:
         # Fixed Expand joins the target node scan at *every* hop, so every
-        # hop output is masked by node existence (+labels/preds).
+        # hop output is masked by node existence (+labels/preds).  The
+        # uniqueness filters the IR emitted map to hop-position pairs.
         hops = [HopSpec(r, tuple(t), d, node_spec(tv, tl))
                 for r, t, d, tv, tl in reversed(hops_rev)]
-        correct_len2 = bool(uniq_pairs) and max_len == 2
         if uniq_pairs and max_len < 2:
             return None
+        pos_of = {h.rel: i + 1 for i, h in enumerate(hops)}
+        uniq_pos = frozenset(
+            (min(pos_of[x], pos_of[y]), max(pos_of[x], pos_of[y]))
+            for x, y in uniq_pairs)
 
     return CountPatternOp(planner.context, fallback, planner.current_graph,
-                          out_name, seed_spec, hops, lengths, correct_len2,
+                          out_name, seed_spec, hops, lengths, uniq_pos,
                           is_varlen=varlen is not None)
 
 
@@ -232,7 +239,7 @@ class CountPatternOp(RelationalOperator):
 
     def __init__(self, context, fallback: RelationalOperator, graph,
                  out_name: str, seed: NodeSpec, hops: Sequence[HopSpec],
-                 lengths: Sequence[int], correct_len2: bool,
+                 lengths: Sequence[int], uniq_pos: frozenset,
                  is_varlen: bool = False):
         super().__init__(context, [fallback])
         self.graph = graph
@@ -240,9 +247,15 @@ class CountPatternOp(RelationalOperator):
         self.seed = seed
         self.hops = list(hops)
         self.lengths = list(lengths)
-        self.correct_len2 = correct_len2
+        # hop-position pairs (i, j), i<j, whose relationships must differ
+        # (Cypher relationship isomorphism)
+        self.uniq_pos = uniq_pos
         self.is_varlen = is_varlen
         self.strategy = "unplanned"
+
+    @property
+    def correct_len2(self) -> bool:
+        return (1, 2) in self.uniq_pos and 2 in self.lengths
 
     # -- array extraction --------------------------------------------------
 
@@ -350,7 +363,8 @@ class CountPatternOp(RelationalOperator):
         return (nsig(self.seed),
                 tuple((tuple(sorted(set(h.rel_types))), h.direction,
                        nsig(h.target)) for h in self.hops),
-                tuple(self.lengths), self.is_varlen, self.correct_len2)
+                tuple(self.lengths), self.is_varlen,
+                tuple(sorted(self.uniq_pos)))
 
     def _graph_static(self, backend, gk) -> dict:
         st = backend.fused_count_static.get(gk)
@@ -369,7 +383,7 @@ class CountPatternOp(RelationalOperator):
         return st
 
     def _fused_scan(self, st, labels: frozenset):
-        """(header, table, ids, static_ok, host_ids, host_ok) for a node
+        """(header, table, static_ok, host_ids, host_ok) for a node
         scan, pure-device only; cached per graph.  The host copies (one
         read each, one-time) feed the numpy-side static builds below —
         on remote transports a handful of numpy sorts beats a dozen
@@ -559,19 +573,26 @@ class CountPatternOp(RelationalOperator):
         if any(e is None for e in hop_edges):
             return None
 
-        correct = self.correct_len2 and 2 in self.lengths
+        lengths = tuple(self.lengths)
+        max_len = max(lengths)
+        is_varlen = self.is_varlen
+        cap1 = backend.bucket(1)
+
         corr = None
-        if correct:
+        if self.correct_len2:
             corr = self._fused_corr(st, n)
             if corr is _UNSUITABLE_CORR:
                 return None
             if corr is not None:
                 corr = self._compact_corr(backend, corr)
 
-        lengths = tuple(self.lengths)
-        max_len = max(lengths)
-        is_varlen = self.is_varlen
-        cap1 = backend.bucket(1)
+        corr3, coef_t = None, 0
+        if max_len == 3 and 3 in lengths and self.uniq_pos:
+            built = self._build_corr3(backend, st, n)
+            if built is _UNSUITABLE_CORR:
+                return None
+            if built is not None:
+                corr3, coef_t = built
 
         # Dtype schedule (gathers dominate the program on TPU — random
         # gather cost scales with element width, so every gather is as
@@ -617,13 +638,14 @@ class CountPatternOp(RelationalOperator):
             return jnp.where(keep, gx, 0).sum(dtype=jnp.int64)
 
         @jax.jit
-        def run(seed_okps, seed_ends, masks, hops, corr):
+        def run(seed_okps, seed_ends, masks, hops, corr, corr3):
             x0 = dense_bool(seed_okps, seed_ends)
             uniq_vecs = [dense_bool(mo, me) for mo, me in masks]
             mask_vecs = [uniq_vecs[i] for i in mask_index]
             end_mask = mask_vecs[0] if is_varlen else mask_vecs[-1]
             total = jnp.int64(0)
             x = x0
+            x1_saved = None
             for length in range(0, max_len + 1):
                 if length in lengths and length < max_len:
                     xl = x.astype(jnp.int64)
@@ -642,6 +664,8 @@ class CountPatternOp(RelationalOperator):
                         x = hop_dense(x, frm, ok, ends, dt)
                         if not is_varlen:
                             x = jnp.where(mask_vecs[length], x, 0)
+                        if length == 0:
+                            x1_saved = x
             if corr is not None:
                 cvalid, a, b, f = corr
                 hit = cvalid & x0[a]
@@ -649,13 +673,190 @@ class CountPatternOp(RelationalOperator):
                     hit = hit & mask_vecs[0][b]
                 hit = hit & (end_mask if is_varlen else mask_vecs[1])[f]
                 total = total - hit.sum(dtype=jnp.int64)
+            if corr3 is not None:
+                # 3-hop inclusion–exclusion over the enforced uniqueness
+                # pairs P: bad = ΣA_p − coef_t·T (every pairwise
+                # intersection of the A_p equals the triple T).
+                c12, c23, i13, c123, d3, pair2 = corr3
+                m1 = None if is_varlen else mask_vecs[0]
+                m2 = None if is_varlen else mask_vecs[1]
+                m3 = end_mask if is_varlen else mask_vecs[2]
+                sub = jnp.int64(0)
+                if c12 is not None:
+                    # A12: e2=e1 at positions (a,b,c); hop 3 continues
+                    # freely — D3[v] = Σ_{e3 from v} m3[far3]
+                    frm3, ok3, ends3, _t3 = d3
+                    D3 = hop_dense(m3, frm3, ok3, ends3, jnp.int32)
+                    cv, a, b, c = c12
+                    keep = cv & x0[a]
+                    if m1 is not None:
+                        keep = keep & m1[b]
+                    if m2 is not None:
+                        keep = keep & m2[c]
+                    sub = sub + jnp.where(keep, D3[c], 0
+                                          ).sum(dtype=jnp.int64)
+                if c23 is not None:
+                    # A23: e3=e2 at positions (b,c,d); weight by the
+                    # number of length-1 walks from the seed into b
+                    cv, b, c, d = c23
+                    keep = cv & m3[d]
+                    if m2 is not None:
+                        keep = keep & m2[c]
+                    sub = sub + jnp.where(keep, x1_saved[b], 0
+                                          ).sum(dtype=jnp.int64)
+                if i13 is not None:
+                    # A13: e3=e1 with e2 free — count hop-2 edges between
+                    # far1(e) and near3(e) via the sorted pair-key table
+                    cv, a, b, c, d = i13
+                    q = b.astype(jnp.int64) * n + c.astype(jnp.int64)
+                    lo = jnp.searchsorted(pair2, q, side="left")
+                    hi = jnp.searchsorted(pair2, q, side="right")
+                    cnt2 = (hi - lo).astype(jnp.int32)
+                    keep = cv & x0[a] & m3[d]
+                    if m1 is not None:
+                        keep = keep & m1[b]
+                    if m2 is not None:
+                        keep = keep & m2[c]
+                    sub = sub + jnp.where(keep, cnt2, 0
+                                          ).sum(dtype=jnp.int64)
+                if c123 is not None and coef_t:
+                    cv, a, b, c, d = c123
+                    keep = cv & x0[a] & m3[d]
+                    if m1 is not None:
+                        keep = keep & m1[b]
+                    if m2 is not None:
+                        keep = keep & m2[c]
+                    sub = sub - coef_t * keep.sum(dtype=jnp.int64)
+                total = total - sub
             return jnp.zeros((cap1,), jnp.int64).at[0].set(total)
 
-        args = (seed_okps, seed_ends, tuple(masks), tuple(hop_edges), corr)
+        args = (seed_okps, seed_ends, tuple(masks), tuple(hop_edges), corr,
+                corr3)
         # Host-side validity: the count row is always valid, and a numpy
         # mask lets result materialization skip one device round trip.
         valid = np.ones((cap1,), bool)
         return (run, args, valid)
+
+    def _build_corr3(self, backend, st, n: int):
+        """Static data for the 3-hop isomorphism correction.
+
+        For a 3-hop chain the excluded walks are the union of A12 (e2=e1),
+        A23 (e3=e2), A13 (e3=e1) over the enforced pairs P; every pairwise
+        intersection of these events is the triple T (all edges equal), so
+        |∪| = ΣA_p − coef·T with coef = max(0, |P|−1).  Each A-term is a
+        per-edge sum over the hops' type-intersection scan (generalizing
+        the 2-hop closed form at _fused_corr / _len2_correction; ref
+        analog: planBoundedVarLengthExpand's rel-uniqueness filters†,
+        SURVEY.md §3.2).  Returns ((c12, c23, i13, c123, d3, pair2),
+        coef) of device arrays, None for a provably-zero correction, or
+        _UNSUITABLE_CORR."""
+        import jax.numpy as jnp
+        h1, h2, h3 = self.hops
+        P = self.uniq_pos
+        if not P:
+            return None
+
+        def role(h, src, tgt):
+            return (src, tgt) if h.direction == Direction.OUTGOING \
+                else (tgt, src)
+
+        def compact(cond, *arrs):
+            (idx,) = np.nonzero(cond)
+            nc = len(idx)
+            if nc == 0:
+                return None
+            cap_c = backend.bucket(nc)
+            idx = np.concatenate([idx, np.zeros(cap_c - nc, idx.dtype)])
+            cvalid = np.arange(cap_c) < nc
+            out = [backend.place_rows(jnp.asarray(cvalid))]
+            out += [backend.place_rows(jnp.asarray(
+                np.clip(a, 0, n - 1).astype(np.int32)[idx])) for a in arrs]
+            return tuple(out)
+
+        def pair_rel(ha, hb):
+            inter = _corr_intersection(ha, hb)
+            if inter is None:
+                return None
+            rel = self._fused_rel(st, tuple(sorted(inter)))
+            if rel is None:
+                return _UNSUITABLE_CORR
+            return rel
+
+        c12 = c23 = i13 = c123 = d3 = pair2 = None
+        if (1, 2) in P:
+            rel = pair_rel(h1, h2)
+            if rel is _UNSUITABLE_CORR:
+                return _UNSUITABLE_CORR
+            if rel is not None and rel[0].shape[0]:
+                src, tgt, ok = rel
+                n1, f1 = role(h1, src, tgt)
+                n2, f2 = role(h2, src, tgt)
+                c12 = compact(ok & (f1 == n2), n1, f1, f2)
+            if c12 is not None:
+                opp = Direction.INCOMING \
+                    if h3.direction == Direction.OUTGOING \
+                    else Direction.OUTGOING
+                d3 = self._fused_edges(
+                    st, tuple(sorted(set(h3.rel_types))), opp, n)
+                if d3 is None:
+                    return _UNSUITABLE_CORR
+        if (2, 3) in P:
+            rel = pair_rel(h2, h3)
+            if rel is _UNSUITABLE_CORR:
+                return _UNSUITABLE_CORR
+            if rel is not None and rel[0].shape[0]:
+                src, tgt, ok = rel
+                n2, f2 = role(h2, src, tgt)
+                n3, f3 = role(h3, src, tgt)
+                c23 = compact(ok & (f2 == n3), n2, f2, f3)
+        if (1, 3) in P:
+            rel = pair_rel(h1, h3)
+            if rel is _UNSUITABLE_CORR:
+                return _UNSUITABLE_CORR
+            if rel is not None and rel[0].shape[0]:
+                src, tgt, ok = rel
+                n1, f1 = role(h1, src, tgt)
+                n3, f3 = role(h3, src, tgt)
+                i13 = compact(ok, n1, f1, n3, f3)
+            if i13 is not None:
+                rel2 = self._fused_rel(
+                    st, tuple(sorted(set(h2.rel_types))))
+                if rel2 is None:
+                    return _UNSUITABLE_CORR
+                s2, t2, ok2 = rel2
+                if s2.shape[0] == 0:
+                    i13 = None  # no hop-2 edges: A13 walks cannot exist
+                else:
+                    n2v, f2v = role(h2, s2, t2)
+                    keys = np.where(ok2, n2v.astype(np.int64) * n + f2v,
+                                    np.int64(2) ** 62)
+                    pair2 = backend.place_rows(jnp.asarray(np.sort(keys)))
+        coef_t = max(0, len(P) - 1)
+        if coef_t:
+            i12t = _corr_intersection(h1, h2)
+            inter3 = None
+            if i12t is not None:
+                t3 = set(h3.rel_types)
+                if not t3:
+                    inter3 = i12t
+                elif not i12t:
+                    inter3 = t3
+                else:
+                    inter3 = (i12t & t3) or None
+            if inter3 is not None:
+                rel = self._fused_rel(st, tuple(sorted(inter3)))
+                if rel is None:
+                    return _UNSUITABLE_CORR
+                src, tgt, ok = rel
+                if src.shape[0]:
+                    n1, f1 = role(h1, src, tgt)
+                    n2, f2 = role(h2, src, tgt)
+                    n3, f3 = role(h3, src, tgt)
+                    c123 = compact(ok & (f1 == n2) & (f2 == n3),
+                                   n1, f1, f2, f3)
+        if c12 is None and c23 is None and i13 is None and c123 is None:
+            return None
+        return ((c12, c23, i13, c123, d3, pair2), coef_t)
 
     def _compact_corr(self, backend, corr):
         """The length-2 correction only involves edges whose reuse
@@ -730,6 +931,11 @@ class CountPatternOp(RelationalOperator):
         if fused is not None:
             return self._emit_fused(*fused)
 
+        if max(self.lengths) >= 3 and self.uniq_pos:
+            # the 3-hop inclusion–exclusion correction only exists on the
+            # fused path; walks-only 3-hop chains may continue below
+            raise _Unsuitable("3-hop isomorphism correction is fused-only")
+
         seed_ids, seed_ok = self._node_ids(self.seed)
         rel_cache: Dict[Tuple[str, ...], tuple] = {}
         for h in self.hops:
@@ -794,7 +1000,7 @@ class CountPatternOp(RelationalOperator):
                     if not self.is_varlen:
                         x = x * mask_vecs[length]
 
-        if self.correct_len2 and 2 in self.lengths:
+        if self.correct_len2:
             if self.is_varlen:
                 corr_masks = (None, end_mask)
             else:

@@ -95,14 +95,15 @@ def test_mixed_direction_chain_not_ring_but_exact():
 
 
 NOT_LOWERED = [
-    # 3 fixed hops: correction is not closed-form
-    "MATCH (a:P)-[:K]->(b)-[:K]->(c)-[:K]->(d) RETURN count(*) AS c",
+    # 4 fixed hops: beyond the inclusion–exclusion correction's reach
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c)-[:K]->(d)-[:K]->(e) "
+    "RETURN count(*) AS c",
     # grouped aggregation
     "MATCH (a:P)-[:K]->(b) RETURN a.name AS n, count(*) AS c",
     # materializing query
     "MATCH (a:P)-[:K]->(b) RETURN b.name AS n",
-    # var-length upper > 2
-    "MATCH (a:P)-[:K*1..3]->(b) RETURN count(*) AS c",
+    # var-length upper > 3
+    "MATCH (a:P)-[:K*1..4]->(b) RETURN count(*) AS c",
     # undirected hop
     "MATCH (a:P)-[:K]-(b) RETURN count(*) AS c",
 ]
@@ -170,6 +171,103 @@ def test_dangling_edges_unlabeled_target():
         res = g.cypher(q)
         assert "CountPattern" in _ops(res), q
         assert res.records.to_maps() == oracle.cypher(q).records.to_maps(), q
+
+
+def _multi_type_graph(session, n=60, seed=3):
+    """Several rel types with overlapping self-loops and parallel edges —
+    the shapes that stress the 3-hop edge-reuse corrections."""
+    rng = np.random.RandomState(seed)
+    nodes = {("P",): [{"_id": i, "name": f"n{i % 7}"} for i in range(n)]}
+
+    def edges(e):
+        return [(int(rng.randint(n)), int(rng.randint(n)), {})
+                for _ in range(e)]
+
+    rels = {"K": edges(220) + [(4, 4, {}), (4, 4, {}), (9, 9, {})],
+            "L": edges(100) + [(4, 4, {})],
+            "M": edges(60)}
+    return make_graph(session, nodes, rels)
+
+
+THREE_HOP_QUERIES = [
+    # uniform type, all outgoing (full P = {12,23,13})
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c)-[:K]->(d) RETURN count(*) AS c",
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c)-[:K]->(d) WHERE a.name = 'n5' "
+    "RETURN count(*) AS c",
+    # mixed directions: go-and-return edge reuse in every pair position
+    "MATCH (a:P)-[:K]->(b)<-[:K]-(c)-[:K]->(d) RETURN count(*) AS c",
+    "MATCH (a:P)<-[:K]-(b)-[:K]->(c)<-[:K]-(d) RETURN count(*) AS c",
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c)<-[:K]-(d) RETURN count(*) AS c",
+    # untyped middle hop: A13 counts hop-2 multiplicity between reused
+    # endpoints over the full edge scan
+    "MATCH (a:P)-[:K]->(b)-[r2]->(c)-[:K]->(d) RETURN count(*) AS c",
+    # overlapping vs disjoint type combos shrink P's effective terms
+    "MATCH (a:P)-[:K]->(b)-[:L]->(c)-[:K]->(d) RETURN count(*) AS c",
+    "MATCH (a:P)-[:K]->(b)-[:L]->(c)-[:M]->(d) RETURN count(*) AS c",
+    "MATCH (a:P)-[:L]->(b)-[:L]->(c)-[:L]->(d) RETURN count(*) AS c",
+    # node predicates at inner and end positions
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c)-[:K]->(d) WHERE b.name = 'n2' "
+    "AND d.name = 'n3' RETURN count(*) AS c",
+    # var-length up to 3 (isomorphism within every length)
+    "MATCH (a:P)-[:K*1..3]->(b) RETURN count(*) AS c",
+    "MATCH (a:P)-[:K*3..3]->(b) WHERE a.name = 'n5' RETURN count(*) AS c",
+    "MATCH (a:P)-[:K*0..3]->(b) WHERE b.name = 'n1' RETURN count(*) AS c",
+    "MATCH (a:P)-[:L*2..3]->(b) RETURN count(*) AS c",
+]
+
+
+@pytest.mark.parametrize("query", THREE_HOP_QUERIES)
+def test_three_hop_pushdown_matches_oracle(query):
+    oracle = _multi_type_graph(LocalCypherSession())
+    session = TPUCypherSession()
+    g = _multi_type_graph(session)
+    want = oracle.cypher(query).records.to_maps()
+    res = g.cypher(query)
+    assert res.records.to_maps() == want, (query, want)
+    assert "CountPattern" in _ops(res), res.plans["relational"]
+    strat = [m for m in res.metrics["operators"]
+             if m["op"] == "CountPattern"][0]["strategy"]
+    assert strat == "fused-spmv", strat
+
+
+def test_three_hop_planner_selects_count_pattern():
+    session = TPUCypherSession()
+    g = _multi_type_graph(session)
+    res = g.cypher("MATCH (a:P)-[:K]->(b)-[:K]->(c)-[:K]->(d) "
+                   "RETURN count(*) AS c")
+    assert "CountPattern" in res.plans["relational"]
+
+
+def test_three_hop_tiny_adversarial_shapes():
+    """Hand-checkable graphs: pure self-loop chains and go-return paths
+    where walks and matches diverge maximally."""
+    nodes = {("P",): [{"_id": 0}, {"_id": 1}, {"_id": 2}, {"_id": 3}]}
+    cases = [
+        # one self loop: walks 0-0-0-0 exist, matches need 3 distinct edges
+        {"K": [(0, 0, {})]},
+        # two parallel self loops: 3 distinct-edge walks impossible (2 edges)
+        {"K": [(0, 0, {}), (0, 0, {})]},
+        # three parallel self loops: 3! orderings match
+        {"K": [(0, 0, {}), (0, 0, {}), (0, 0, {})]},
+        # triangle plus chord
+        {"K": [(0, 1, {}), (1, 2, {}), (2, 0, {}), (0, 2, {})]},
+        # go-return pair between two nodes
+        {"K": [(0, 1, {}), (1, 0, {})]},
+        # parallel edges both directions
+        {"K": [(0, 1, {}), (0, 1, {}), (1, 0, {}), (1, 0, {})]},
+    ]
+    queries = [
+        "MATCH (a:P)-[:K]->(b)-[:K]->(c)-[:K]->(d) RETURN count(*) AS c",
+        "MATCH (a:P)-[:K]->(b)<-[:K]-(c)-[:K]->(d) RETURN count(*) AS c",
+        "MATCH (a:P)-[:K*1..3]->(b) RETURN count(*) AS c",
+    ]
+    for rels in cases:
+        oracle = make_graph(LocalCypherSession(), nodes, rels)
+        g = make_graph(TPUCypherSession(), nodes, rels)
+        for q in queries:
+            want = oracle.cypher(q).records.to_maps()
+            got = g.cypher(q).records.to_maps()
+            assert got == want, (rels, q, want, got)
 
 
 def test_untyped_and_typed_hops_edge_reuse_correction():
