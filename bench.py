@@ -261,8 +261,10 @@ def run_ldbc_config(on_tpu: bool):
         _emit()
         return
     scale = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
+    # result_sink=_result: every completed query lands in the best-so-far
+    # dict, so a deadline abort emits honest partial results.
     report = run_ldbc_bench(scale=scale, on_tpu=on_tpu,
-                            remaining_s=_remaining)
+                            remaining_s=_remaining, result_sink=_result)
     _result.update(report)
     _emit()
 

@@ -371,7 +371,7 @@ class DeviceTable(Table):
             counts, lo = K.probe_count(self._join_key(lcol), l_ok, rk_sorted)
         total = self.backend.consume_count(K.join_total(counts, l_ok, left_join))
         out_cap = self.backend.bucket(total)
-        if self.backend.config.use_pallas:
+        if self.backend.config.use_pallas and OPS.pallas_usable():
             l_idx, r_idx, out_valid, r_matched = OPS.join_expand_via_positions(
                 counts, lo, perm, l_ok, out_cap, left_join,
                 interpret=OPS.default_interpret())
@@ -580,7 +580,7 @@ class DeviceTable(Table):
         Returns None when the shape doesn't fit (engine falls back to the
         sorted path)."""
         cfg = self.backend.config
-        if not cfg.use_pallas or len(by) != 1:
+        if not cfg.use_pallas or not OPS.pallas_usable() or len(by) != 1:
             return None
         if any(a.distinct or a.kind == "collect" for a in aggs):
             return None  # sorted path handles distinct/collect

@@ -22,6 +22,7 @@ from caps_tpu.ops.expand import (
     expand_positions_ref,
     join_expand_via_positions,
 )
+from caps_tpu.ops.probe import pallas_usable
 
 __all__ = [
     "dense_segment_agg",
@@ -33,4 +34,5 @@ __all__ = [
     "expand_positions",
     "expand_positions_ref",
     "join_expand_via_positions",
+    "pallas_usable",
 ]

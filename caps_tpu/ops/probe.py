@@ -1,0 +1,183 @@
+"""Runtime capability probe for compiled Pallas kernels.
+
+The engine's Pallas kernels are differential-tested in interpreter mode
+everywhere, but whether they *compile* on the active TPU stack depends on
+the toolchain (e.g. remote-compile transports may reject scalar-prefetch
+grids, or hang on specific kernel shapes).  A broken kernel must degrade
+to its jnp twin, never crash or wedge a query — so the first compiled use
+is gated by a one-time probe that builds representative kernels in a
+subprocess (immune to compiler hangs) and caches the verdict on disk per
+jaxlib version.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_VERDICT: Optional[bool] = None
+
+_PROBE_SRC = r"""
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import functools
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# family 1: plain grid + iota/compare/reduce (segment aggregation shape)
+def k1(x_ref, o_ref):
+    t = jax.lax.broadcasted_iota(jnp.int32, (256, 128), 1)
+    offs = x_ref[:].reshape(256, 1)
+    o_ref[:] = jnp.sum((offs <= t).astype(jnp.int32), axis=1,
+                       dtype=jnp.int32)
+x = jnp.arange(256, dtype=jnp.int32)
+out = pl.pallas_call(k1, out_shape=jax.ShapeDtypeStruct((256,), jnp.int32))(x)
+out.block_until_ready()
+
+# family 2: scalar-prefetch grid with data-dependent block indexing
+def k2(blk_ref, x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2
+tile, n_tiles = 256, 4
+xs = jnp.arange(tile * n_tiles, dtype=jnp.int32)
+blk = jnp.arange(n_tiles, dtype=jnp.int32)
+grid_spec = pltpu.PrefetchScalarGridSpec(
+    num_scalar_prefetch=1,
+    grid=(n_tiles,),
+    in_specs=[pl.BlockSpec((tile,), lambda i, blk: (blk[i],),
+                           memory_space=pltpu.VMEM)],
+    out_specs=[pl.BlockSpec((tile,), lambda i, blk: (i,),
+                            memory_space=pltpu.VMEM)],
+)
+out2 = pl.pallas_call(k2, grid_spec=grid_spec,
+                      out_shape=[jax.ShapeDtypeStruct((tile * n_tiles,),
+                                                      jnp.int32)])(blk, xs)
+out2[0].block_until_ready()
+print("PALLAS_PROBE_OK")
+"""
+
+
+def _cache_path() -> str:
+    import jaxlib
+    ver = getattr(jaxlib, "__version__", "unknown")
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        f"caps_tpu_pallas_probe_{ver}.json")
+
+
+_PALLAS_ERR_MARKERS = ("pallas", "mosaic", "RecursionError",
+                       "remote_compile", "tpu_compile")
+
+
+def pallas_usable(timeout_s: float = 180.0) -> bool:
+    """True if compiled Pallas kernels work on the default backend.
+
+    Non-TPU backends always return True (kernels run in interpreter mode
+    there).  On TPU the verdict comes from a subprocess probe, cached in
+    memory and on disk.  ``CAPS_TPU_PALLAS_PROBE=1`` / ``0`` overrides
+    the probe entirely (and is the recovery knob for a stale cached
+    verdict — delete the cache file or set the env).  A subprocess that
+    failed WITHOUT a Pallas/Mosaic-shaped error (e.g. it could not
+    acquire an exclusively-held local device) does not condemn the
+    stack — the probe retries in-process, where only the quick failure
+    modes can occur.
+    """
+    global _VERDICT
+    override = os.environ.get("CAPS_TPU_PALLAS_PROBE")
+    if override is not None:
+        return override.strip().lower() in ("1", "true", "yes", "on")
+    if _VERDICT is not None:
+        return _VERDICT
+    import jax
+    if jax.default_backend() != "tpu":
+        _VERDICT = True
+        return True
+    path = _cache_path()
+    try:
+        with open(path) as f:
+            _VERDICT = bool(json.load(f)["usable"])
+            return _VERDICT
+    except Exception:
+        pass
+    reason = ""
+    try:
+        proc = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        ok = proc.returncode == 0 and "PALLAS_PROBE_OK" in proc.stdout
+        if not ok:
+            err = (proc.stderr or "") + (proc.stdout or "")
+            reason = err[-500:]
+            if not any(m.lower() in err.lower()
+                       for m in _PALLAS_ERR_MARKERS):
+                # failure unrelated to Pallas (device contention, env):
+                # probe in-process — crash-style failures raise quickly
+                ok, reason = _probe_inprocess()
+    except subprocess.TimeoutExpired:
+        ok, reason = False, f"probe timed out after {timeout_s}s"
+    except Exception as ex:
+        ok, reason = _probe_inprocess()
+        reason = reason or str(ex)
+    if not ok:
+        import logging
+        logging.getLogger("caps_tpu").warning(
+            "compiled Pallas kernels disabled on this TPU stack "
+            "(falling back to jnp twins): %s — override with "
+            "CAPS_TPU_PALLAS_PROBE=1 or delete %s", reason.strip()[:200],
+            path)
+    _VERDICT = ok
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"usable": ok, "reason": reason.strip()[:500]}, f)
+    except Exception:
+        pass
+    return ok
+
+
+def _probe_inprocess():
+    """Last-resort probe in this process (no hang protection; used only
+    when the subprocess failed for reasons unrelated to Pallas)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def k1(x_ref, o_ref):
+            t = jax.lax.broadcasted_iota(jnp.int32, (256, 128), 1)
+            offs = x_ref[:].reshape(256, 1)
+            o_ref[:] = jnp.sum((offs <= t).astype(jnp.int32), axis=1,
+                               dtype=jnp.int32)
+
+        x = jnp.arange(256, dtype=jnp.int32)
+        pl.pallas_call(
+            k1, out_shape=jax.ShapeDtypeStruct((256,), jnp.int32)
+        )(x).block_until_ready()
+
+        # scalar-prefetch grids are the feature remote-compile stacks
+        # reject; the engine's expand kernel needs them
+        from jax.experimental.pallas import tpu as pltpu
+
+        def k2(blk_ref, x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2
+
+        tile, n_tiles = 256, 4
+        xs = jnp.arange(tile * n_tiles, dtype=jnp.int32)
+        blk = jnp.arange(n_tiles, dtype=jnp.int32)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_tiles,),
+            in_specs=[pl.BlockSpec((tile,), lambda i, b: (b[i],),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=[pl.BlockSpec((tile,), lambda i, b: (i,),
+                                    memory_space=pltpu.VMEM)],
+        )
+        out = pl.pallas_call(
+            k2, grid_spec=grid_spec,
+            out_shape=[jax.ShapeDtypeStruct((tile * n_tiles,), jnp.int32)],
+        )(blk, xs)
+        out[0].block_until_ready()
+        return True, ""
+    except Exception as ex:
+        return False, str(ex)[:500]

@@ -584,7 +584,7 @@ class CountPatternOp(RelationalOperator):
             if corr is _UNSUITABLE_CORR:
                 return None
             if corr is not None:
-                corr = self._compact_corr(backend, corr)
+                corr = self._compact_cond(backend, n, *corr)
 
         corr3, coef_t = None, 0
         if max_len == 3 and 3 in lengths and self.uniq_pos:
@@ -761,17 +761,7 @@ class CountPatternOp(RelationalOperator):
                 else (tgt, src)
 
         def compact(cond, *arrs):
-            (idx,) = np.nonzero(cond)
-            nc = len(idx)
-            if nc == 0:
-                return None
-            cap_c = backend.bucket(nc)
-            idx = np.concatenate([idx, np.zeros(cap_c - nc, idx.dtype)])
-            cvalid = np.arange(cap_c) < nc
-            out = [backend.place_rows(jnp.asarray(cvalid))]
-            out += [backend.place_rows(jnp.asarray(
-                np.clip(a, 0, n - 1).astype(np.int32)[idx])) for a in arrs]
-            return tuple(out)
+            return self._compact_cond(backend, n, cond, *arrs)
 
         def pair_rel(ha, hb):
             inter = _corr_intersection(ha, hb)
@@ -858,24 +848,23 @@ class CountPatternOp(RelationalOperator):
             return None
         return ((c12, c23, i13, c123, d3, pair2), coef_t)
 
-    def _compact_corr(self, backend, corr):
-        """The length-2 correction only involves edges whose reuse
-        condition holds — a static property of the graph — so compact to
-        that (usually tiny) subset host-side at build time."""
+    def _compact_cond(self, backend, n: int, cond, *arrs):
+        """Compact per-edge correction data to the (usually tiny) subset
+        where ``cond`` holds — a static property of the graph — clipping
+        indices into [0, n) and padding to a bucket.  Returns (cvalid,
+        *clipped) device arrays, or None when no edge qualifies."""
         import jax.numpy as jnp
-        cond, a, b, f = corr
         (idx,) = np.nonzero(cond)
         nc = len(idx)
         if nc == 0:
             return None
         cap_c = backend.bucket(nc)
-        pad = np.zeros(cap_c - nc, dtype=idx.dtype)
-        idx = np.concatenate([idx, pad])
+        idx = np.concatenate([idx, np.zeros(cap_c - nc, idx.dtype)])
         cvalid = np.arange(cap_c) < nc
-        return (backend.place_rows(jnp.asarray(cvalid)),
-                backend.place_rows(jnp.asarray(a[idx])),
-                backend.place_rows(jnp.asarray(b[idx])),
-                backend.place_rows(jnp.asarray(f[idx])))
+        out = [backend.place_rows(jnp.asarray(cvalid))]
+        out += [backend.place_rows(jnp.asarray(
+            np.clip(a, 0, n - 1).astype(np.int32)[idx])) for a in arrs]
+        return tuple(out)
 
     def _fused_corr(self, st, n: int):
         """Static per-edge data for the length-2 isomorphism correction:
