@@ -229,14 +229,47 @@ class DeviceExprCompiler:
         l = self.compile(e.lhs)
         r = self.compile(e.rhs)
         valid = l.valid & r.valid
-        try:
-            l2, r2 = self._promote(l, r)
-            eq = l2.data == r2.data
-        except UnsupportedOnDevice:
-            eq = jnp.zeros(self.capacity, bool)  # mismatched kinds: never equal
+        if l.kind == "list" or r.kind == "list":
+            eq = self._list_equal(l, r)
+        else:
+            try:
+                l2, r2 = self._promote(l, r)
+                eq = l2.data == r2.data
+            except UnsupportedOnDevice:
+                # mismatched kinds: never equal
+                eq = jnp.zeros(self.capacity, bool)
         if isinstance(e, E.NotEquals):
             eq = ~eq
         return Column("bool", eq, valid, CTBoolean)
+
+    def _list_equal(self, l: Column, r: Column) -> jnp.ndarray:
+        """Elementwise list equality: lengths match and every in-range
+        element matches.  Device list elements are int32 codes; code
+        spaces are only comparable within the same element kind (ids and
+        ints share the numeric space)."""
+        from caps_tpu.backends.tpu.column import list_elem_kind
+        if l.kind != "list" or r.kind != "list":
+            return jnp.zeros(self.capacity, bool)
+        ekl = list_elem_kind(l.ctype)
+        ekr = list_elem_kind(r.ctype)
+        # code spaces only align within one element kind — and 'id' lists
+        # hold entities, which never equal integers in openCypher
+        if ekl != ekr:
+            return jnp.zeros(self.capacity, bool)
+        W = max(l.data.shape[1], r.data.shape[1], 1)
+
+        def pad(d):
+            if d.shape[1] == W:
+                return d
+            return jnp.concatenate(
+                [d, jnp.zeros((d.shape[0], W - d.shape[1]), d.dtype)],
+                axis=1)
+
+        ld, rd = pad(l.data), pad(r.data)
+        pos = jnp.arange(W)[None, :]
+        within = pos < l.lens[:, None]
+        elems_eq = (ld == rd) | ~within
+        return (l.lens == r.lens) & elems_eq.all(axis=1)
 
     def _ordering(self, e) -> Column:
         l = self.compile(e.lhs)
@@ -439,8 +472,7 @@ class DeviceExprCompiler:
                 return Column("int", c.lens.astype(jnp.int64), c.valid,
                               CTInteger)
             if c.kind == "str":
-                lengths = np.array([len(s) for s in self.pool._strings],
-                                   dtype=np.int64)
+                lengths = self.pool.lengths_array()
                 if lengths.shape[0] == 0:
                     return Column("int", jnp.zeros(self.capacity, jnp.int64),
                                   c.valid, CTInteger)

@@ -110,6 +110,15 @@ class StringPool:
             self._fn_luts[key] = out
         return self._fn_luts[key]
 
+    def lengths_array(self) -> np.ndarray:
+        """int64 table mapping each code to len(string); cached per pool
+        version (rebuilding per query would stall on large pools)."""
+        key = ("__lengths__", self.version)
+        if key not in self._fn_luts:
+            self._fn_luts[key] = np.array(
+                [len(s) for s in self._strings], dtype=np.int64)
+        return self._fn_luts[key]
+
 
 class NativeStringPool(StringPool):
     """StringPool over the C++ host runtime: bulk encode/decode and rank
@@ -173,3 +182,8 @@ class NativeStringPool(StringPool):
     def map_lut(self, name: str, fn: Callable[[str], str]) -> np.ndarray:
         self._snapshot()
         return super().map_lut(name, fn)
+
+    def lengths_array(self) -> np.ndarray:
+        if ("__lengths__", self.version) not in self._fn_luts:
+            self._snapshot()  # refresh _strings only on cache miss
+        return super().lengths_array()

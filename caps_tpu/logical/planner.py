@@ -233,9 +233,8 @@ class _QueryPlanner:
             rhs, pred = self._rewrite_exists(rhs, pred)
             rhs = L.Filter(rhs, pred, fields=rhs.fields)
         if block.optional:
-            if not lhs.fields:
-                raise LogicalPlanningError(
-                    "OPTIONAL MATCH requires a preceding binding clause")
+            # A leading OPTIONAL MATCH left-joins against the single unit
+            # driving row: no match yields one all-null row (openCypher).
             out = L.Optional(lhs, rhs, fields=rhs.fields)
         else:
             out = rhs

@@ -543,6 +543,27 @@ class OptionalJoinOp(RelationalOperator):
         new_entries = [(e, rh.column(e), rh.type_of(e).nullable)
                        for e in rh.exprs
                        if not lh.has(e) and e != E.Var(self.rid_col)]
+        if self.rid_col not in rt.columns:
+            # The optional pattern shares no variable with the lhs (e.g. a
+            # leading OPTIONAL MATCH over the unit driving row), so it
+            # never consumed the tagged rows: OPTIONAL MATCH then pairs
+            # every lhs row with every rhs row, or null-pads when the
+            # pattern found nothing (openCypher).
+            out_header = RecordHeader(
+                [(e, lh.column(e), lh.type_of(e)) for e in lh.exprs
+                 if e != E.Var(self.rid_col)] + new_entries)
+            new_cols = [c for _, c, _ in new_entries if c not in lhs_cols]
+            if rt.size == 0:
+                out = lt
+                for e, c, t in new_entries:
+                    if c not in lhs_cols:
+                        out = out.with_literal_column(c, None, t)
+            else:
+                out = lt.join(rt.select(list(dict.fromkeys(new_cols))),
+                              "cross", [])
+            keep = [c for c in out.columns if c != self.rid_col]
+            return out_header, out.select(keep).select(
+                list(out_header.columns))
         rid_right = f"__opt_{self.rid_col}"
         sel_cols = [self.rid_col] + [c for _, c, _ in new_entries
                                      if c not in lhs_cols]

@@ -1,0 +1,203 @@
+Feature: Aggregation edge cases
+
+  Scenario: avg of integers is a float
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS v RETURN avg(v) AS a
+      """
+    Then the result should be, in any order:
+      | a   |
+      | 1.5 |
+
+  Scenario: sum of mixed int and float is float
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2.5] AS v RETURN sum(v) AS s
+      """
+    Then the result should be, in any order:
+      | s   |
+      | 3.5 |
+
+  Scenario: min and max over strings are lexicographic
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND ['pear', 'apple', 'fig'] AS v RETURN min(v) AS mn, max(v) AS mx
+      """
+    Then the result should be, in any order:
+      | mn      | mx     |
+      | 'apple' | 'pear' |
+
+  Scenario: min and max over negative and zero values
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [-3, 0, 2] AS v RETURN min(v) AS mn, max(v) AS mx
+      """
+    Then the result should be, in any order:
+      | mn | mx |
+      | -3 | 2  |
+
+  Scenario: count of rows vs count of values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN count(*) AS rows, count(p.v) AS vals
+      """
+    Then the result should be, in any order:
+      | rows | vals |
+      | 2    | 1    |
+
+  Scenario: grouping keys include rows whose aggregate input is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'x', v: 1}), (:P {g: 'x'}), (:P {g: 'y'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.g AS g, count(p.v) AS c
+      """
+    Then the result should be, in any order:
+      | g   | c |
+      | 'x' | 1 |
+      | 'y' | 0 |
+
+  Scenario: null grouping key forms its own group
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'x', v: 1}), (:P {v: 2}), (:P {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.g AS g, sum(p.v) AS s
+      """
+    Then the result should be, in any order:
+      | g    | s |
+      | 'x'  | 1 |
+      | null | 5 |
+
+  Scenario: aggregation without grouping keys over zero rows yields one row
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (p:Nope) RETURN count(p) AS c, sum(p.v) AS s, collect(p.v) AS l
+      """
+    Then the result should be, in any order:
+      | c | s | l  |
+      | 0 | 0 | [] |
+
+  Scenario: grouped aggregation over zero rows yields no rows
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (p:Nope) RETURN p.g AS g, count(*) AS c
+      """
+    Then the result should be empty
+
+  Scenario: multiple aggregates in one projection share the grouping
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'x', v: 1}), (:P {g: 'x', v: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      RETURN p.g AS g, count(*) AS c, sum(p.v) AS s, min(p.v) AS mn,
+             max(p.v) AS mx, avg(p.v) AS a
+      """
+    Then the result should be, in any order:
+      | g   | c | s | mn | mx | a   |
+      | 'x' | 2 | 4 | 1  | 3  | 2.0 |
+
+  Scenario: collect preserves duplicates
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 1, 2] AS v WITH v ORDER BY v RETURN collect(v) AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 1, 2] |
+
+  Scenario: aggregate of an arithmetic expression
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS v RETURN sum(v * v) AS s
+      """
+    Then the result should be, in any order:
+      | s  |
+      | 14 |
+
+  Scenario: count distinct on a grouped query
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'x', v: 1}), (:P {g: 'x', v: 1}), (:P {g: 'x', v: 2}),
+             (:P {g: 'y', v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.g AS g, count(DISTINCT p.v) AS c
+      """
+    Then the result should be, in any order:
+      | g   | c |
+      | 'x' | 2 |
+      | 'y' | 1 |
+
+  Scenario: min over mixed int and float compares numerically
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [2, 1.5, 3] AS v RETURN min(v) AS mn
+      """
+    Then the result should be, in any order:
+      | mn  |
+      | 1.5 |
+
+  Scenario: sum over floats keeps float type
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [0.5, 0.25] AS v RETURN sum(v) AS s
+      """
+    Then the result should be, in any order:
+      | s    |
+      | 0.75 |
+
+  Scenario: grouping by two keys
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 'x'}), (:P {a: 1, b: 'x'}), (:P {a: 1, b: 'y'}),
+             (:P {a: 2, b: 'x'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.a AS a, p.b AS b, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | a | b   | c |
+      | 1 | 'x' | 2 |
+      | 1 | 'y' | 1 |
+      | 2 | 'x' | 1 |
+
+  Scenario: aggregation result feeds arithmetic in a later stage
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3, 4] AS v WITH count(v) AS n, sum(v) AS s
+      RETURN s / n AS mean
+      """
+    Then the result should be, in any order:
+      | mean |
+      | 2    |

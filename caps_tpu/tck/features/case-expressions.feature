@@ -1,0 +1,125 @@
+Feature: CASE expressions
+
+  Scenario: generic CASE picks the first true branch
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 5, 9] AS x
+      RETURN x, CASE WHEN x < 3 THEN 'small' WHEN x < 7 THEN 'mid'
+                ELSE 'big' END AS c
+      """
+    Then the result should be, in any order:
+      | x | c       |
+      | 1 | 'small' |
+      | 5 | 'mid'   |
+      | 9 | 'big'   |
+
+  Scenario: simple CASE matches on value equality
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS x
+      RETURN CASE x WHEN 1 THEN 'one' WHEN 2 THEN 'two' ELSE 'many' END AS c
+      """
+    Then the result should be, in any order:
+      | c      |
+      | 'one'  |
+      | 'two'  |
+      | 'many' |
+
+  Scenario: simple CASE without default yields null on no match
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [9] AS x RETURN CASE x WHEN 1 THEN 'one' END AS c
+      """
+    Then the result should be, in any order:
+      | c    |
+      | null |
+
+  Scenario: CASE branches can yield different numeric kinds
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS x RETURN CASE WHEN x = 1 THEN 10 ELSE 2.5 END AS c
+      """
+    Then the result should be, in any order:
+      | c   |
+      | 10  |
+      | 2.5 |
+
+  Scenario: CASE result usable in WHERE
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS x
+      WITH x, CASE WHEN x % 2 = 0 THEN 'even' ELSE 'odd' END AS p
+      WHERE p = 'odd' RETURN x
+      """
+    Then the result should be, in any order:
+      | x |
+      | 1 |
+      | 3 |
+
+  Scenario: nested CASE expressions
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS x
+      RETURN CASE WHEN x < 3 THEN CASE WHEN x = 1 THEN 'a' ELSE 'b' END
+             ELSE 'c' END AS c
+      """
+    Then the result should be, in any order:
+      | c   |
+      | 'a' |
+      | 'b' |
+      | 'c' |
+
+  Scenario: CASE over a null scrutinee with simple form matches nothing
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN CASE p.x WHEN 1 THEN 'one' ELSE 'other' END AS c
+      """
+    Then the result should be, in any order:
+      | c       |
+      | 'other' |
+
+  Scenario: CASE branch conditions evaluate in order
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [4] AS x
+      RETURN CASE WHEN x > 1 THEN 'first' WHEN x > 2 THEN 'second' END AS c
+      """
+    Then the result should be, in any order:
+      | c       |
+      | 'first' |
+
+  Scenario: CASE can return null explicitly
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS x RETURN CASE WHEN x = 1 THEN null ELSE x END AS c
+      """
+    Then the result should be, in any order:
+      | c    |
+      | null |
+      | 2    |
+
+  Scenario: CASE in ORDER BY key
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND ['b', 'a', 'c'] AS x
+      RETURN x ORDER BY CASE WHEN x = 'c' THEN 0 ELSE 1 END, x
+      """
+    Then the result should be, in order:
+      | x   |
+      | 'c' |
+      | 'a' |
+      | 'b' |

@@ -1,0 +1,195 @@
+Feature: Duplicate elimination semantics
+
+  Scenario: DISTINCT treats null values as equal
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P), (:P), (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN DISTINCT p.v AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+      | 1    |
+
+  Scenario: DISTINCT over multiple columns dedups tuples not columns
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 1}), (:P {a: 1, b: 2}), (:P {a: 1, b: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN DISTINCT p.a AS a, p.b AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 1 | 1 |
+      | 1 | 2 |
+
+  Scenario: DISTINCT distinguishes int from bool
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: true}), (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN DISTINCT p.v AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | 1    |
+      | true |
+
+  Scenario: count DISTINCT skips nulls but dedups values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 1}), (:P {v: 2}), (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN count(DISTINCT p.v) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: sum DISTINCT adds each value once
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 3}), (:P {v: 3}), (:P {v: 4})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN sum(DISTINCT p.v) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 7 |
+
+  Scenario: collect DISTINCT dedups collected values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 1}), (:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH DISTINCT p.v AS v ORDER BY v RETURN collect(v) AS l
+      """
+    Then the result should be, in any order:
+      | l      |
+      | [1, 2] |
+
+  Scenario: UNION dedups identical rows across arms
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS v RETURN v
+      UNION
+      UNWIND [2, 3] AS v RETURN v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 2 |
+      | 3 |
+
+  Scenario: UNION ALL keeps duplicates across arms
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS v RETURN v
+      UNION ALL
+      UNWIND [2, 3] AS v RETURN v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 2 |
+      | 2 |
+      | 3 |
+
+  Scenario: UNION also dedups within each arm
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 1] AS v RETURN v
+      UNION
+      UNWIND [2] AS v RETURN v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 2 |
+
+  Scenario: UNION dedups rows containing nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.x AS v
+      UNION
+      MATCH (p:P) RETURN p.x AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+
+  Scenario: DISTINCT on node values dedups by identity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q), (a)-[:R]->(:Q)
+      """
+    When executing query:
+      """
+      MATCH (p:P)-[:R]->() RETURN DISTINCT p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: WITH DISTINCT limits downstream cardinality
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'x', v: 1}), (:P {g: 'x', v: 2}), (:P {g: 'y', v: 3})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH DISTINCT p.g AS g RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: DISTINCT on float and int of equal value dedups
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 1.0] AS v RETURN DISTINCT v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+
+  Scenario: DISTINCT lists compare elementwise
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [[1, 2], [1, 2], [2, 1]] AS l RETURN DISTINCT l
+      """
+    Then the result should be, in any order:
+      | l      |
+      | [1, 2] |
+      | [2, 1] |

@@ -1,0 +1,271 @@
+Feature: List values, indexing and slicing
+
+  Scenario: list literal round-trips
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [1, 2, 3] AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |
+
+  Scenario: empty list literal
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [] AS l, size([]) AS s
+      """
+    Then the result should be, in any order:
+      | l  | s |
+      | [] | 0 |
+
+  Scenario: positive indexing is zero-based
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [7, 8, 9][0] AS a, [7, 8, 9][2] AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 7 | 9 |
+
+  Scenario: negative indexing counts from the end
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [7, 8, 9][-1] AS a, [7, 8, 9][-3] AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 9 | 7 |
+
+  Scenario: out-of-range index is null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [7, 8, 9][5] AS a, [7, 8, 9][-4] AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: indexing with a null index is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN [1, 2][p.i] AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+
+  Scenario: list slicing with both bounds
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [1, 2, 3, 4][1..3] AS l
+      """
+    Then the result should be, in any order:
+      | l      |
+      | [2, 3] |
+
+  Scenario: list slicing with open ends
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [1, 2, 3][1..] AS a, [1, 2, 3][..2] AS b
+      """
+    Then the result should be, in any order:
+      | a      | b      |
+      | [2, 3] | [1, 2] |
+
+  Scenario: list slicing with negative bounds
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [1, 2, 3, 4][-2..] AS a
+      """
+    Then the result should be, in any order:
+      | a      |
+      | [3, 4] |
+
+  Scenario: size of a list literal
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN size([1, 2, 3]) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 3 |
+
+  Scenario: UNWIND over a list produces one row per element
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [10, 20, 30] AS v RETURN v
+      """
+    Then the result should be, in any order:
+      | v  |
+      | 10 |
+      | 20 |
+      | 30 |
+
+  Scenario: UNWIND of an empty list produces no rows
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [] AS v RETURN v
+      """
+    Then the result should be empty
+
+  Scenario: UNWIND of null produces no rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) UNWIND p.missing AS v RETURN v
+      """
+    Then the result should be empty
+
+  Scenario: UNWIND preserves duplicates
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 1, 2] AS v RETURN v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 1 |
+      | 2 |
+
+  Scenario: nested UNWIND forms the cross product
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS a UNWIND [10, 20] AS b RETURN a, b
+      """
+    Then the result should be, in any order:
+      | a | b  |
+      | 1 | 10 |
+      | 1 | 20 |
+      | 2 | 10 |
+      | 2 | 20 |
+
+  Scenario: list equality is elementwise
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [1, 2] = [1, 2] AS a, [1, 2] = [2, 1] AS b
+      """
+    Then the result should be, in any order:
+      | a    | b     |
+      | true | false |
+
+  Scenario: list of strings round-trips
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN ['a', 'b'] AS l
+      """
+    Then the result should be, in any order:
+      | l          |
+      | ['a', 'b'] |
+
+  Scenario: collect builds a list that UNWIND flattens back
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH collect(p.v) AS l UNWIND l AS v RETURN v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v |
+      | 1 |
+      | 2 |
+
+  Scenario: range function produces an inclusive sequence
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN range(1, 4) AS l
+      """
+    Then the result should be, in any order:
+      | l            |
+      | [1, 2, 3, 4] |
+
+  Scenario: range with a step
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN range(0, 6, 2) AS l
+      """
+    Then the result should be, in any order:
+      | l            |
+      | [0, 2, 4, 6] |
+
+  Scenario: head last and tail of a list
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN head([1, 2, 3]) AS h, last([1, 2, 3]) AS l, tail([1, 2, 3]) AS t
+      """
+    Then the result should be, in any order:
+      | h | l | t      |
+      | 1 | 3 | [2, 3] |
+
+  Scenario: head and last of an empty list are null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN head([]) AS h, last([]) AS l
+      """
+    Then the result should be, in any order:
+      | h    | l    |
+      | null | null |
+
+  Scenario: reverse of a list
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN reverse([1, 2, 3]) AS r
+      """
+    Then the result should be, in any order:
+      | r         |
+      | [3, 2, 1] |
+
+  Scenario: list concatenation with plus
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN [1, 2] + [3] AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |
+
+  Scenario: IN over a list parameter
+    Given an empty graph
+    And parameters are:
+      | xs | [1, 3] |
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS v WITH v WHERE v IN $xs RETURN v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 3 |

@@ -1,0 +1,286 @@
+Feature: Pattern matching shapes
+
+  Scenario: undirected pattern matches both orientations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'})-[:R]->(:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x:P)-[:R]-(y:P) RETURN x.n AS x, y.n AS y
+      """
+    Then the result should be, in any order:
+      | x   | y   |
+      | 'a' | 'b' |
+      | 'b' | 'a' |
+
+  Scenario: self loop matches with both endpoints bound to the same node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x:P)-[:R]->(y:P) RETURN x.n AS x, y.n AS y
+      """
+    Then the result should be, in any order:
+      | x   | y   |
+      | 'a' | 'a' |
+
+  Scenario: repeated node variable forces a cycle
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(b:P {n: 'b'}), (b)-[:R]->(a),
+             (b)-[:R]->(:P {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (x)-[:R]->(y)-[:R]->(x) RETURN x.n AS x, y.n AS y
+      """
+    Then the result should be, in any order:
+      | x   | y   |
+      | 'a' | 'b' |
+      | 'b' | 'a' |
+
+  Scenario: two comma patterns share bound variables
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(b:Q), (a)-[:S]->(:T)
+      """
+    When executing query:
+      """
+      MATCH (x:P)-[:R]->(q:Q), (x)-[:S]->(t:T) RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: disconnected comma patterns form a cartesian product
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:A {v: 2}), (:B {w: 10})
+      """
+    When executing query:
+      """
+      MATCH (a:A), (b:B) RETURN a.v AS v, b.w AS w
+      """
+    Then the result should be, in any order:
+      | v | w  |
+      | 1 | 10 |
+      | 2 | 10 |
+
+  Scenario: multiple labels on a node pattern require all of them
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {n: 'ab'}), (:A {n: 'a'}), (:B {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x:A:B) RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n    |
+      | 'ab' |
+
+  Scenario: relationship type alternation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q {n: 'q1'}), (a)-[:S]->(:Q {n: 'q2'}),
+             (a)-[:T]->(:Q {n: 'q3'})
+      """
+    When executing query:
+      """
+      MATCH (:P)-[:R|S]->(q:Q) RETURN q.n AS n
+      """
+    Then the result should be, in any order:
+      | n    |
+      | 'q1' |
+      | 'q2' |
+
+  Scenario: relationship property predicate in the pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P)-[:R {w: 1}]->(:Q {n: 'light'}), (a)-[:R {w: 9}]->(:Q {n: 'heavy'})
+      """
+    When executing query:
+      """
+      MATCH (:P)-[r:R {w: 9}]->(q:Q) RETURN q.n AS n
+      """
+    Then the result should be, in any order:
+      | n       |
+      | 'heavy' |
+
+  Scenario: node property map predicate in the pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'x', v: 1}), (:P {n: 'y', v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P {v: 2}) RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'y' |
+
+  Scenario: anonymous intermediate nodes are not deduplicated
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q), (a)-[:R]->(:Q)
+      """
+    When executing query:
+      """
+      MATCH (p:P)-[:R]->() RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+      | 'a' |
+
+  Scenario: incoming direction arrowhead
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'src'})-[:R]->(:P {n: 'dst'})
+      """
+    When executing query:
+      """
+      MATCH (x)<-[:R]-(y) RETURN x.n AS x, y.n AS y
+      """
+    Then the result should be, in any order:
+      | x     | y     |
+      | 'dst' | 'src' |
+
+  Scenario: relationship uniqueness within one MATCH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(b:P {n: 'b'}), (b)-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:R]->(y)-[r2:R]->(x) WHERE x.n = 'a' RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: same relationship cannot be used twice in one pattern
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:R]->(x)-[r2:R]->(x) RETURN x.n AS n
+      """
+    Then the result should be empty
+
+  Scenario: var-length lower bound zero includes the start node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:R*0..1]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+      | 'b' |
+
+  Scenario: var-length exact bound
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'})-[:R]->(:P {n: 'b'})-[:R]->(:P {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:R*2..2]->(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'c' |
+
+  Scenario: var-length undirected walks both ways without edge reuse
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(b:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x:P {n: 'a'})-[:R*1..2]-(y) RETURN y.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+
+  Scenario: matching a label that does not exist yields nothing
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (x:Nope) RETURN x
+      """
+    Then the result should be empty
+
+  Scenario: match returns whole nodes structurally
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a', v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p
+      """
+    Then the result should be, in any order:
+      | p                   |
+      | (:P {n: 'a', v: 1}) |
+
+  Scenario: match returns whole relationships structurally
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)-[:R {w: 2}]->(:Q)
+      """
+    When executing query:
+      """
+      MATCH ()-[r:R]->() RETURN r
+      """
+    Then the result should be, in any order:
+      | r           |
+      | [:R {w: 2}] |
+
+  Scenario: longer chain across mixed labels
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 1})-[:R]->(:B {n: 2})-[:S]->(:C {n: 3})-[:T]->(:D {n: 4})
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:R]->(b)-[:S]->(c)-[:T]->(d:D)
+      RETURN a.n AS a, b.n AS b, c.n AS c, d.n AS d
+      """
+    Then the result should be, in any order:
+      | a | b | c | d |
+      | 1 | 2 | 3 | 4 |

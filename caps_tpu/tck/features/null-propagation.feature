@@ -1,0 +1,316 @@
+Feature: Null propagation through operators and functions
+
+  Scenario: equality with null is null on both sides
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN null = 1 AS a, 1 = null AS b, null = null AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | null |
+
+  Scenario: inequality with null is null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN null <> 1 AS a, null <> null AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: ordering comparisons with null are null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN null < 1 AS a, null <= 1 AS b, null > 1 AS c, null >= null AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d    |
+      | null | null | null | null |
+
+  Scenario: NOT null is null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN NOT null AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | null |
+
+  Scenario: AND truth table with null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN (true AND null) AS a, (false AND null) AS b, (null AND null) AS c
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    |
+      | null | false | null |
+
+  Scenario: OR truth table with null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN (true OR null) AS a, (false OR null) AS b, (null OR null) AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | true | null | null |
+
+  Scenario: XOR with null is null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN (true XOR null) AS a, (false XOR null) AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: arithmetic operators all propagate null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN null + 1 AS a, 1 - null AS b, null * 2 AS c, 4 / null AS d,
+             null % 3 AS e
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d    | e    |
+      | null | null | null | null | null |
+
+  Scenario: unary minus of null is null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN -null AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | null |
+
+  Scenario: IS NULL and IS NOT NULL are never null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN null IS NULL AS a, null IS NOT NULL AS b,
+             1 IS NULL AS c, 1 IS NOT NULL AS d
+      """
+    Then the result should be, in any order:
+      | a    | b     | c     | d    |
+      | true | false | false | true |
+
+  Scenario: string predicates with null are null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      RETURN p.s STARTS WITH 'a' AS a, p.s ENDS WITH 'a' AS b,
+             p.s CONTAINS 'a' AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | null | null | null |
+
+  Scenario: coalesce returns the first non-null value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {b: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN coalesce(p.a, p.b, 99) AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
+
+  Scenario: coalesce of all nulls is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN coalesce(p.a, p.b) AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+
+  Scenario: toUpper of null is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN toUpper(p.s) AS u, toLower(p.s) AS l
+      """
+    Then the result should be, in any order:
+      | u    | l    |
+      | null | null |
+
+  Scenario: size of null is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN size(p.s) AS s
+      """
+    Then the result should be, in any order:
+      | s    |
+      | null |
+
+  Scenario: abs and sqrt of null are null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN abs(p.x) AS a, sqrt(p.x) AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: null IN a list is null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN null IN [1, 2] AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | null |
+
+  Scenario: value found in a list containing null is true
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 1 IN [1, null] AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | true |
+
+  Scenario: value not found in a list containing null is null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 2 IN [1, null] AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | null |
+
+  Scenario: value not found in a null-free list is false
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 3 IN [1, 2] AS a
+      """
+    Then the result should be, in any order:
+      | a     |
+      | false |
+
+  Scenario: WHERE treats null as false
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'}), (:P {n: 'b', flag: true}), (:P {n: 'c', flag: false})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.flag RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'b' |
+
+  Scenario: property access on a null entity is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'solo'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q) RETURN p.n AS n, q.x AS x
+      """
+    Then the result should be, in any order:
+      | n      | x    |
+      | 'solo' | null |
+
+  Scenario: null modulo and division keep null even with zero divisor
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN null / 0 AS a, null % 0 AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: CASE with null condition takes the default
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN CASE WHEN p.x > 1 THEN 'big' ELSE 'dunno' END AS v
+      """
+    Then the result should be, in any order:
+      | v       |
+      | 'dunno' |
+
+  Scenario: CASE without default yields null when nothing matches
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [5] AS x RETURN CASE WHEN x < 3 THEN 'small' END AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | null |
+
+  Scenario: equality between different types is false not null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 1 = 'a' AS a, true = 1 AS b, 'a' = false AS c
+      """
+    Then the result should be, in any order:
+      | a     | b     | c     |
+      | false | false | false |
+
+  Scenario: integer and float compare numerically
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 1 = 1.0 AS a, 2 > 1.5 AS b, 1.0 < 2 AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | true | true | true |

@@ -1,0 +1,248 @@
+Feature: Numeric functions and arithmetic semantics
+
+  Scenario: abs of negative int and float
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN abs(-5) AS a, abs(-2.5) AS b, abs(3) AS c
+      """
+    Then the result should be, in any order:
+      | a | b   | c |
+      | 5 | 2.5 | 3 |
+
+  Scenario: sign function
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN sign(-7) AS a, sign(0) AS b, sign(4) AS c
+      """
+    Then the result should be, in any order:
+      | a  | b | c |
+      | -1 | 0 | 1 |
+
+  Scenario: sqrt of a perfect square is float
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN sqrt(9) AS a
+      """
+    Then the result should be, in any order:
+      | a   |
+      | 3.0 |
+
+  Scenario: ceil and floor
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN ceil(2.1) AS a, floor(2.9) AS b, ceil(-2.1) AS c, floor(-2.1) AS d
+      """
+    Then the result should be, in any order:
+      | a   | b   | c    | d    |
+      | 3.0 | 2.0 | -2.0 | -3.0 |
+
+  Scenario: round to nearest
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN round(2.4) AS a, round(2.5) AS b
+      """
+    Then the result should be, in any order:
+      | a   | b   |
+      | 2.0 | 3.0 |
+
+  Scenario: integer division truncates toward zero
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 7 / 2 AS a, -7 / 2 AS b
+      """
+    Then the result should be, in any order:
+      | a | b  |
+      | 3 | -3 |
+
+  Scenario: float division keeps fractions
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 7.0 / 2 AS a, 7 / 2.0 AS b
+      """
+    Then the result should be, in any order:
+      | a   | b   |
+      | 3.5 | 3.5 |
+
+  Scenario: modulo follows the dividend sign
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 7 % 3 AS a, -7 % 3 AS b, 7 % -3 AS c
+      """
+    Then the result should be, in any order:
+      | a | b  | c |
+      | 1 | -1 | 1 |
+
+  Scenario: power is float valued
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 2 ^ 10 AS a, 4 ^ 0.5 AS b
+      """
+    Then the result should be, in any order:
+      | a      | b   |
+      | 1024.0 | 2.0 |
+
+  Scenario: toInteger truncates floats
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN toInteger(2.9) AS a, toInteger(-2.9) AS b
+      """
+    Then the result should be, in any order:
+      | a | b  |
+      | 2 | -2 |
+
+  Scenario: toFloat widens integers
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN toFloat(3) AS a
+      """
+    Then the result should be, in any order:
+      | a   |
+      | 3.0 |
+
+  Scenario: operator precedence multiplication before addition
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 2 + 3 * 4 AS a, (2 + 3) * 4 AS b
+      """
+    Then the result should be, in any order:
+      | a  | b  |
+      | 14 | 20 |
+
+  Scenario: unary minus binds tighter than comparison
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN -2 < 1 AS a, -(2 + 1) AS b
+      """
+    Then the result should be, in any order:
+      | a    | b  |
+      | true | -3 |
+
+  Scenario: large integers survive round trips
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {big: 9007199254740993})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.big AS b, p.big + 1 AS b1
+      """
+    Then the result should be, in any order:
+      | b                | b1               |
+      | 9007199254740993 | 9007199254740994 |
+
+  Scenario: negative zero float equals zero
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN -0.0 = 0.0 AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | true |
+
+  Scenario: log and exp invert
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN round(log(exp(2.0)) * 10) AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | 20.0 |
+
+  Scenario: integer division by zero raises an error
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 1 / 0 AS a
+      """
+    Then a ArithmeticError should be raised
+
+  Scenario: arithmetic on booleans is not implicit
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN true + true AS a
+      """
+    Then the result should be, in any order:
+      | a |
+      | 2 |
+
+  Scenario: parameter arithmetic
+    Given an empty graph
+    And parameters are:
+      | n | 4 |
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN $n * 2 AS a, $n % 3 AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 8 | 1 |
+
+  Scenario: aggregates over computed numeric functions
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [-2, -1, 3] AS v RETURN sum(abs(v)) AS s, max(sign(v)) AS m
+      """
+    Then the result should be, in any order:
+      | s | m |
+      | 6 | 1 |
+
+  Scenario: float formatting preserves integral floats
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 1.0 + 2.0 AS a
+      """
+    Then the result should be, in any order:
+      | a   |
+      | 3.0 |
+
+  Scenario: mixed numeric comparison chain in WHERE
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [0.5, 1, 1.5, 2] AS v WITH v WHERE v >= 1 AND v < 2 RETURN v
+      """
+    Then the result should be, in any order:
+      | v   |
+      | 1   |
+      | 1.5 |
+
+  Scenario: integer overflow boundary stays exact at 2^53
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN 9007199254740992 + 1 = 9007199254740992 AS collides
+      """
+    Then the result should be, in any order:
+      | collides |
+      | false    |
+
+  Scenario: round half away from zero on negative numbers
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN round(-2.5) AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | -2.0 |

@@ -1,0 +1,202 @@
+Feature: OPTIONAL MATCH interacting with aggregation and predicates
+
+  Scenario: count of a null-padded variable skips the null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q:Q)
+      RETURN p.n AS n, count(q) AS c
+      """
+    Then the result should be, in any order:
+      | n   | c |
+      | 'a' | 1 |
+      | 'b' | 0 |
+
+  Scenario: count star counts null-padded rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q:Q)
+      RETURN p.n AS n, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | n   | c |
+      | 'a' | 1 |
+      | 'b' | 1 |
+
+  Scenario: collect over optional rows skips nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P)-[:R]->(:Q {v: 1}), (a)-[:R]->(:Q {v: 2}), (:P {n: 'lonely'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q:Q)
+      WITH p, q.v AS v ORDER BY v
+      RETURN collect(v) AS l
+      """
+    Then the result should be, in any order:
+      | l      |
+      | [1, 2] |
+
+  Scenario: WHERE inside OPTIONAL MATCH pads instead of filtering
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q {v: 1}), (b:P {n: 'b'})-[:R]->(:Q {v: 9})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q:Q) WHERE q.v < 5
+      RETURN p.n AS n, q.v AS v
+      """
+    Then the result should be, in any order:
+      | n   | v    |
+      | 'a' | 1    |
+      | 'b' | null |
+
+  Scenario: WHERE after WITH filters the padded rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q {v: 1}), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q:Q)
+      WITH p, q WHERE q IS NOT NULL RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: two OPTIONAL MATCHes pad independently
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q {v: 1}), (a)-[:S]->(:T {w: 2}),
+             (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      OPTIONAL MATCH (p)-[:R]->(q:Q)
+      OPTIONAL MATCH (p)-[:S]->(t:T)
+      RETURN p.n AS n, q.v AS v, t.w AS w
+      """
+    Then the result should be, in any order:
+      | n   | v    | w    |
+      | 'a' | 1    | 2    |
+      | 'b' | null | null |
+
+  Scenario: min and max over an all-null optional column are null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'}), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q:Q)
+      RETURN min(q.v) AS mn, max(q.v) AS mx, sum(q.v) AS s
+      """
+    Then the result should be, in any order:
+      | mn   | mx   | s |
+      | null | null | 0 |
+
+  Scenario: avg ignores nulls in the mix
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P)-[:R]->(:Q {v: 2}), (a)-[:R]->(:Q), (a)-[:R]->(:Q {v: 4})
+      """
+    When executing query:
+      """
+      MATCH (:P)-[:R]->(q:Q) RETURN avg(q.v) AS a, count(q.v) AS c
+      """
+    Then the result should be, in any order:
+      | a   | c |
+      | 3.0 | 2 |
+
+  Scenario: optional variable usable in later expressions
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q {v: 10}), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q:Q)
+      RETURN p.n AS n, q.v + 1 AS v1
+      """
+    Then the result should be, in any order:
+      | n   | v1   |
+      | 'a' | 11   |
+      | 'b' | null |
+
+  Scenario: OPTIONAL MATCH on an empty graph yields one null row
+    Given an empty graph
+    When executing query:
+      """
+      OPTIONAL MATCH (n:Nothing) RETURN n
+      """
+    Then the result should be, in any order:
+      | n    |
+      | null |
+
+  Scenario: grouping key can be a null-padded value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q {g: 'x'}),
+             (b:P {n: 'b'})-[:R]->(:Q {g: 'x'}), (:P {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R]->(q:Q)
+      RETURN q.g AS g, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | g    | c |
+      | 'x'  | 2 |
+      | null | 1 |
+
+  Scenario: OPTIONAL MATCH relationship variable is null when unmatched
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[r:R]->() RETURN r IS NULL AS isnull
+      """
+    Then the result should be, in any order:
+      | isnull |
+      | true   |
+
+  Scenario: aggregation after optional var-length expand
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(b:P {n: 'b'})-[:R]->(c:P {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:R*1..2]->(q:P)
+      RETURN p.n AS n, count(q) AS c
+      """
+    Then the result should be, in any order:
+      | n   | c |
+      | 'a' | 2 |
+      | 'b' | 1 |
+      | 'c' | 0 |

@@ -1,0 +1,225 @@
+Feature: ORDER BY, SKIP, LIMIT and cross-type comparability
+
+  Scenario: nulls order last ascending
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 2}), (:P), (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.v AS v ORDER BY v ASC
+      """
+    Then the result should be, in order:
+      | v    |
+      | 1    |
+      | 2    |
+      | null |
+
+  Scenario: nulls order first descending
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 2}), (:P), (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.v AS v ORDER BY v DESC
+      """
+    Then the result should be, in order:
+      | v    |
+      | null |
+      | 2    |
+      | 1    |
+
+  Scenario: multi-key ordering applies keys left to right
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 2}), (:P {a: 1, b: 1}), (:P {a: 0, b: 9})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.a AS a, p.b AS b ORDER BY a ASC, b DESC
+      """
+    Then the result should be, in order:
+      | a | b |
+      | 0 | 9 |
+      | 1 | 2 |
+      | 1 | 1 |
+
+  Scenario: ORDER BY a computed expression
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [3, 1, 2] AS v RETURN v ORDER BY -v
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 2 |
+      | 1 |
+
+  Scenario: ORDER BY boolean sorts false before true
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [true, false] AS v RETURN v ORDER BY v ASC
+      """
+    Then the result should be, in order:
+      | v     |
+      | false |
+      | true  |
+
+  Scenario: ORDER BY mixes ints and floats numerically
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [2.5, 1, 3, 0.5] AS v RETURN v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v   |
+      | 0.5 |
+      | 1   |
+      | 2.5 |
+      | 3   |
+
+  Scenario: SKIP drops leading rows after ordering
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [5, 3, 1, 4, 2] AS v RETURN v ORDER BY v SKIP 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 3 |
+      | 4 |
+      | 5 |
+
+  Scenario: LIMIT keeps leading rows after ordering
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [5, 3, 1, 4, 2] AS v RETURN v ORDER BY v LIMIT 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 1 |
+      | 2 |
+
+  Scenario: SKIP and LIMIT page through results
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [5, 3, 1, 4, 2] AS v RETURN v ORDER BY v SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+
+  Scenario: SKIP beyond the result size yields nothing
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS v RETURN v SKIP 5
+      """
+    Then the result should be empty
+
+  Scenario: LIMIT zero yields nothing
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS v RETURN v LIMIT 0
+      """
+    Then the result should be empty
+
+  Scenario: cross-type ordering comparison is null
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 1 < 'a' AS a, true < 1 AS b
+      """
+    Then the result should be, in any order:
+      | a    | b    |
+      | null | null |
+
+  Scenario: cross-type WHERE comparison filters the row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 'str'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.v > 0 RETURN p.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+
+  Scenario: ORDER BY on strings is lexicographic
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND ['pear', 'apple', 'fig'] AS v RETURN v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v       |
+      | 'apple' |
+      | 'fig'   |
+      | 'pear'  |
+
+  Scenario: ordering is stable across equal keys with a secondary key
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 1, n: 'b'}), (:P {g: 1, n: 'a'}), (:P {g: 0, n: 'z'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.g AS g, p.n AS n ORDER BY g, n
+      """
+    Then the result should be, in order:
+      | g | n   |
+      | 0 | 'z' |
+      | 1 | 'a' |
+      | 1 | 'b' |
+
+  Scenario: LIMIT applies after aggregation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'a'}), (:P {g: 'a'}), (:P {g: 'b'}), (:P {g: 'c'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.g AS g, count(*) AS c ORDER BY c DESC, g LIMIT 2
+      """
+    Then the result should be, in order:
+      | g   | c |
+      | 'a' | 2 |
+      | 'b' | 1 |
+
+  Scenario: ORDER BY an alias defined in WITH
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS v WITH v * -1 AS neg RETURN neg ORDER BY neg
+      """
+    Then the result should be, in order:
+      | neg |
+      | -3  |
+      | -2  |
+      | -1  |
+
+  Scenario: SKIP LIMIT inside WITH bounds intermediate cardinality
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [5, 4, 3, 2, 1] AS v WITH v ORDER BY v LIMIT 3
+      RETURN sum(v) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 6 |

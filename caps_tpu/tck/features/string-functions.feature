@@ -1,0 +1,231 @@
+Feature: String functions and predicates
+
+  Scenario: case conversion round trip
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN toUpper('MixEd') AS u, toLower('MixEd') AS l
+      """
+    Then the result should be, in any order:
+      | u       | l       |
+      | 'MIXED' | 'mixed' |
+
+  Scenario: trim variants
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN trim('  pad  ') AS t, lTrim('  pad') AS lt, rTrim('pad  ') AS rt
+      """
+    Then the result should be, in any order:
+      | t     | lt    | rt    |
+      | 'pad' | 'pad' | 'pad' |
+
+  Scenario: reverse of a string
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN reverse('abc') AS r
+      """
+    Then the result should be, in any order:
+      | r     |
+      | 'cba' |
+
+  Scenario: size of strings counts characters
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN size('') AS a, size('abc') AS b
+      """
+    Then the result should be, in any order:
+      | a | b |
+      | 0 | 3 |
+
+  Scenario: every string starts with and ends with the empty string
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN 'abc' STARTS WITH '' AS a, 'abc' ENDS WITH '' AS b,
+             'abc' CONTAINS '' AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | true | true | true |
+
+  Scenario: string predicates are case sensitive
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN 'Apple' STARTS WITH 'a' AS a, 'Apple' STARTS WITH 'A' AS b
+      """
+    Then the result should be, in any order:
+      | a     | b    |
+      | false | true |
+
+  Scenario: CONTAINS finds interior substrings
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN 'banana' CONTAINS 'nan' AS a, 'banana' CONTAINS 'nano' AS b
+      """
+    Then the result should be, in any order:
+      | a    | b     |
+      | true | false |
+
+  Scenario: ENDS WITH on exact match
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN 'abc' ENDS WITH 'abc' AS a, 'abc' ENDS WITH 'dabc' AS b
+      """
+    Then the result should be, in any order:
+      | a    | b     |
+      | true | false |
+
+  Scenario: string concatenation with plus
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 'foo' + 'bar' AS s
+      """
+    Then the result should be, in any order:
+      | s        |
+      | 'foobar' |
+
+  Scenario: substring extraction
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN substring('hello', 1, 3) AS s
+      """
+    Then the result should be, in any order:
+      | s     |
+      | 'ell' |
+
+  Scenario: substring without length runs to the end
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN substring('hello', 2) AS s
+      """
+    Then the result should be, in any order:
+      | s     |
+      | 'llo' |
+
+  Scenario: left and right prefixes
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN left('hello', 2) AS l, right('hello', 2) AS r
+      """
+    Then the result should be, in any order:
+      | l    | r    |
+      | 'he' | 'lo' |
+
+  Scenario: replace substitutes every occurrence
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN replace('aXbXc', 'X', '-') AS s
+      """
+    Then the result should be, in any order:
+      | s       |
+      | 'a-b-c' |
+
+  Scenario: split produces a list
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN split('a,b,c', ',') AS l
+      """
+    Then the result should be, in any order:
+      | l               |
+      | ['a', 'b', 'c'] |
+
+  Scenario: toString of numbers and booleans
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN toString(42) AS a, toString(true) AS b
+      """
+    Then the result should be, in any order:
+      | a    | b      |
+      | '42' | 'true' |
+
+  Scenario: string ordering is lexicographic
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x
+      RETURN 'abc' < 'abd' AS a, 'Z' < 'a' AS b, 'ab' < 'abc' AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    |
+      | true | true | true |
+
+  Scenario: string property comparison filters rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'ant'}), (:P {n: 'bee'}), (:P {n: 'cat'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.n >= 'bee' RETURN p.n AS n
+      """
+    Then the result should be, in any order:
+      | n     |
+      | 'bee' |
+      | 'cat' |
+
+  Scenario: strings with special characters round-trip
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {s: 'tab\tand "quotes"'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.s CONTAINS 'and' AS c
+      """
+    Then the result should be, in any order:
+      | c    |
+      | true |
+
+  Scenario: empty string is not null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {s: ''})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.s IS NULL AS a, size(p.s) AS b
+      """
+    Then the result should be, in any order:
+      | a     | b |
+      | false | 0 |
+
+  Scenario: toInteger parses strings
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN toInteger('42') AS a, toInteger('nope') AS b
+      """
+    Then the result should be, in any order:
+      | a  | b    |
+      | 42 | null |
+
+  Scenario: toFloat parses strings
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN toFloat('2.5') AS a, toFloat('nope') AS b
+      """
+    Then the result should be, in any order:
+      | a   | b    |
+      | 2.5 | null |

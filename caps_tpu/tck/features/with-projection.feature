@@ -1,0 +1,187 @@
+Feature: WITH projection, scoping and pipeline composition
+
+  Scenario: WITH narrows the variable scope
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p.a AS a RETURN a
+      """
+    Then the result should be, in any order:
+      | a |
+      | 1 |
+
+  Scenario: expression aliases compose across WITH stages
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS v WITH v * 10 AS tens WITH tens + 1 AS ones
+      RETURN ones
+      """
+    Then the result should be, in any order:
+      | ones |
+      | 11   |
+      | 21   |
+
+  Scenario: WHERE after WITH filters on the alias
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3, 4] AS v WITH v WHERE v % 2 = 0 RETURN v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
+      | 4 |
+
+  Scenario: aggregation inside WITH groups by the other projections
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'x', v: 1}), (:P {g: 'x', v: 2}), (:P {g: 'y', v: 5})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p.g AS g, sum(p.v) AS s RETURN g, s
+      """
+    Then the result should be, in any order:
+      | g   | s |
+      | 'x' | 3 |
+      | 'y' | 5 |
+
+  Scenario: aggregate of an aggregate via two WITH stages
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'x'}), (:P {g: 'x'}), (:P {g: 'y'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p.g AS g, count(*) AS c RETURN max(c) AS biggest
+      """
+    Then the result should be, in any order:
+      | biggest |
+      | 2       |
+
+  Scenario: match continues after WITH carrying a node variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(:Q {v: 1}), (a)-[:R]->(:Q {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p MATCH (p)-[:R]->(q:Q) RETURN p.n AS n, q.v AS v
+      """
+    Then the result should be, in any order:
+      | n   | v |
+      | 'a' | 1 |
+      | 'a' | 2 |
+
+  Scenario: variables not projected by WITH are out of scope
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH p.a AS a RETURN b
+      """
+    Then a SyntaxError should be raised
+
+  Scenario: RETURN alias shadows the original property name
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 7})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.v AS v ORDER BY v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 7 |
+
+  Scenario: WITH star keeps every variable in scope
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH * RETURN p.a AS a
+      """
+    Then the result should be, in any order:
+      | a |
+      | 1 |
+
+  Scenario: chained MATCH WITH MATCH multiplies cardinality correctly
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1}), (:A {v: 2}), (:B {w: 10}), (:B {w: 20})
+      """
+    When executing query:
+      """
+      MATCH (a:A) WITH a MATCH (b:B) RETURN a.v AS v, b.w AS w
+      """
+    Then the result should be, in any order:
+      | v | w  |
+      | 1 | 10 |
+      | 1 | 20 |
+      | 2 | 10 |
+      | 2 | 20 |
+
+  Scenario: aliasing a constant expression
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 1 + 2 AS three, 'a' AS letter
+      """
+    Then the result should be, in any order:
+      | three | letter |
+      | 3     | 'a'    |
+
+  Scenario: parameter values flow through WITH
+    Given an empty graph
+    And parameters are:
+      | lim | 2 |
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS v WITH v WHERE v <= $lim RETURN v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 2 |
+
+  Scenario: RETURN can reference an alias in the same clause ordering
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [2, 1] AS v RETURN v AS x ORDER BY x
+      """
+    Then the result should be, in order:
+      | x |
+      | 1 |
+      | 2 |
+
+  Scenario: unwinding an aggregated collect after WITH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 2}), (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH collect(p.v) AS l RETURN size(l) AS s
+      """
+    Then the result should be, in any order:
+      | s |
+      | 2 |

@@ -150,6 +150,8 @@ def test_filter_on_rel_stays_above_expand():
     assert fi < ei
 
 
-def test_optional_without_binding_fails():
-    with pytest.raises(LogicalPlanningError):
-        plan("OPTIONAL MATCH (a) RETURN a")
+def test_optional_without_binding_plans_against_unit_row():
+    # openCypher: a leading OPTIONAL MATCH left-joins the single unit
+    # driving row, yielding one all-null row when nothing matches.
+    out = plan("OPTIONAL MATCH (a) RETURN a")
+    assert "Optional" in out.pretty()

@@ -22,8 +22,8 @@ def _session(backend):
 
 
 def test_corpus_is_nontrivial():
-    assert len(SCENARIOS) >= 60
-    assert len({s.feature for s in SCENARIOS}) >= 8
+    assert len(SCENARIOS) >= 300
+    assert len({s.feature for s in SCENARIOS}) >= 15
 
 
 @pytest.mark.parametrize("backend", BACKENDS)
