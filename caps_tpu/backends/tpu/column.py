@@ -86,10 +86,25 @@ class Column:
     valid: jnp.ndarray           # bool (capacity,)
     ctype: CypherType
     lens: Optional[jnp.ndarray] = None  # int32 (capacity,) for kind="list"
+    # Ingest-time host mirror (data_np, valid_np): scan columns keep the
+    # numpy arrays they were built from, so host-side plan builders (the
+    # fused count pushdown, the ring var-expand) never re-download graph
+    # columns over the transport.  Derived columns drop it.
+    host: Optional[tuple] = None
 
     @property
     def capacity(self) -> int:
         return int(self.data.shape[0])
+
+    def host_arrays(self):
+        """(data, valid) as numpy: the ingest-time mirror when present,
+        else one device read each (a transport round trip)."""
+        if self.host is not None:
+            return self.host
+        d = np.asarray(self.data)
+        v = (self.valid if isinstance(self.valid, np.ndarray)
+             else np.asarray(self.valid))
+        return d, v
 
     def astype_kind(self, kind: str) -> "Column":
         if kind == self.kind:
@@ -127,14 +142,14 @@ def make_column(values: List[Any], ctype: CypherType, capacity: int,
         data_np[:n] = np.where(codes >= 0, codes, 0)
         valid_np[:n] = codes >= 0
         return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np),
-                      ctype)
+                      ctype, host=(data_np, valid_np))
     fast = _make_column_native(values, kind, n)
     if fast is not None:
         d, v = fast
         data_np[:n] = d
         valid_np[:n] = v
         return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np),
-                      ctype)
+                      ctype, host=(data_np, valid_np))
     for i, v in enumerate(values):
         if v is None:
             continue
@@ -147,7 +162,8 @@ def make_column(values: List[Any], ctype: CypherType, capacity: int,
             data_np[i] = float(v)
         else:
             data_np[i] = int(v)
-    return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np), ctype)
+    return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np), ctype,
+                  host=(data_np, valid_np))
 
 
 def _check_id(iv: int) -> int:

@@ -57,14 +57,24 @@ class DeviceBackend:
         if config.compile_cache_dir and \
                 DeviceBackend._persistent_cache_dir != config.compile_cache_dir:
             # Persistent XLA compilation cache: repeat processes reuse
-            # compiled executables (jax only persists entries whose compile
-            # time exceeds its threshold, so tiny test programs skip it).
-            # jax_compilation_cache_dir is process-global; the last
-            # explicitly-configured directory wins.
+            # compiled executables.  jax_compilation_cache_dir is
+            # process-global; the last explicitly-configured directory wins.
+            # The min-compile-time threshold must drop to 0: a query here
+            # executes as many sub-second programs, and on remote-compile
+            # transports each one pays a full compile round trip — exactly
+            # the entries the default 1 s threshold refuses to persist.
+            # TPU only: persisted XLA:CPU executables are host-machine AOT
+            # code, and reloading them on a host with different CPU
+            # features risks SIGILL (observed with virtual-device test
+            # meshes); TPU executables are device binaries and portable.
             try:
-                jax.config.update("jax_compilation_cache_dir",
-                                  config.compile_cache_dir)
-                DeviceBackend._persistent_cache_dir = config.compile_cache_dir
+                if jax.default_backend() == "tpu":
+                    jax.config.update("jax_compilation_cache_dir",
+                                      config.compile_cache_dir)
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs", 0.0)
+                    DeviceBackend._persistent_cache_dir = \
+                        config.compile_cache_dir
             except Exception:
                 pass
         self.fallbacks = 0
@@ -105,10 +115,12 @@ class DeviceBackend:
     def place_column(self, col: Column) -> Column:
         if self.mesh is None:
             return col
+        # resharding moves device buffers only — the ingest host mirror
+        # still describes the same values
         return Column(col.kind, self.place_rows(col.data),
                       self.place_rows(col.valid), col.ctype,
                       self.place_rows(col.lens) if col.lens is not None
-                      else None)
+                      else None, host=col.host)
 
     def bucket(self, n: int) -> int:
         return max(1, self.config.bucket_for(n))
@@ -836,6 +848,20 @@ class DeviceTable(Table):
         if self._local is not None:
             return self._local.column_values(col)
         return column_to_host(self._cols[col], self._n, self.backend.pool)
+
+    def host_column(self, col: str):
+        """(values, ok) numpy host view of an integer column — the
+        ingest-time mirror when present (Column.host), else one device
+        read each.  ``ok`` folds in row validity.  None when the column
+        has no host-plannable integer representation; host plan builders
+        (count pushdown, ring var-expand) key off this."""
+        if self._local is not None:
+            return None
+        c = self._cols.get(col)
+        if c is None or c.kind not in ("id", "int"):
+            return None
+        d, v = c.host_arrays()
+        return d, v & (np.arange(c.capacity) < self._n)
 
     def device_column(self, col: str):
         """(data, valid, live_row_count) without host materialization —

@@ -112,6 +112,116 @@ def make_ring_khop(mesh: Mesh, n_nodes: int, n_hops: int,
     return call
 
 
+def _ring_hop_matrix(f_block, edge_src, edge_dst, edge_ok, *, axis: str,
+                     n_nodes: int, n_shards: int):
+    """One hop of the MATRIX frontier: ``f_block`` is the (seeds,
+    node-block) slice of a per-seed path-count matrix F[s, v].  Blocks
+    rotate around the ring exactly as in ``_ring_hop``; the seed axis
+    stays local, so this is the general VarExpand frontier exchange — the
+    aggregate form above is the seeds==1 special case."""
+    nb = n_nodes // n_shards
+    n_seeds = f_block.shape[0]
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(t, carry):
+        blk, acc = carry  # blk: (S, nb); acc: (S, E_local)
+        block_id = (my - t) % n_shards
+        lo = block_id * nb
+        m = edge_ok & (edge_src >= lo) & (edge_src < lo + nb)
+        local = jnp.clip(edge_src - lo, 0, nb - 1)
+        acc = acc + jnp.where(m[None, :], blk[:, local], 0)
+        blk = jax.lax.ppermute(blk, axis, perm)
+        return blk, acc
+
+    acc0 = jax.lax.pcast(
+        jnp.zeros((n_seeds, edge_src.shape[0]), f_block.dtype), axis,
+        to="varying")
+    _, per_edge = jax.lax.fori_loop(0, n_shards, body, (f_block, acc0))
+    local_out = jax.ops.segment_sum(per_edge.T, edge_dst,
+                                    num_segments=n_nodes)  # (N, S)
+    out = jax.lax.psum_scatter(local_out, axis, scatter_dimension=0,
+                               tiled=True)  # (nb, S)
+    return out.T
+
+
+def make_ring_varexpand(mesh: Mesh, n_nodes: int, lengths: tuple,
+                        axis: str = "shard"):
+    """Jitted ring-scheduled var-length expand: per-seed PATH-count matrix
+    over the union of ``lengths`` (each in 0..2), with the relationship-
+    isomorphism correction applied at length 2 (the only invalid length-2
+    walk under a uniform direction is a self-loop edge reused immediately,
+    so paths2 = walks2 - diag(self-loop count)).  Inputs arrive sharded:
+    the seed-indicator matrix F0 (seeds, n_nodes) node-block sharded on
+    its node axis, edges edge-sharded, the target-node mask node-block
+    sharded.  Output is the (seeds, n_nodes) multiplicity matrix M[s, v] =
+    #paths seed_s ->..-> v with len in ``lengths`` and v in the mask."""
+    n_shards = int(mesh.devices.size)
+    if n_nodes % n_shards:
+        raise ValueError(f"n_nodes {n_nodes} must divide over {n_shards}")
+    max_len = max(lengths) if lengths else 0
+    if max_len > 2:
+        raise ValueError("ring var-expand supports lengths <= 2")
+    hop = functools.partial(_ring_hop_matrix, axis=axis, n_nodes=n_nodes,
+                            n_shards=n_shards)
+
+    def body(f0_block, edge_src, edge_dst, edge_ok, tmask_block):
+        out = jnp.zeros_like(f0_block)
+        if 0 in lengths:
+            out = out + f0_block * tmask_block[None, :]
+        f = f0_block
+        for length in range(1, max_len + 1):
+            f = hop(f, edge_src, edge_dst, edge_ok)
+            if length == 2:
+                # isomorphism correction: the walk s -e-> s -e-> s (e a
+                # self-loop at s) reuses its relationship; remove one walk
+                # per self-loop, landing on the diagonal — F0 * loops[v].
+                is_loop = edge_ok & (edge_src == edge_dst)
+                loc = jax.ops.segment_sum(
+                    is_loop.astype(f.dtype), edge_dst, num_segments=n_nodes)
+                loops = jax.lax.psum_scatter(loc, axis, scatter_dimension=0,
+                                             tiled=True)  # (nb,)
+                f = f - f0_block * loops[None, :]
+            if length in lengths:
+                out = out + f * tmask_block[None, :]
+        return out
+
+    mapped = shard_map(body, mesh=mesh,
+                       in_specs=(P(None, axis), P(axis), P(axis), P(axis),
+                                 P(axis)),
+                       out_specs=P(None, axis))
+    return jax.jit(mapped)
+
+
+def ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok, tmask,
+                             lengths: tuple):
+    """Single-device jnp twin for differential tests."""
+    n_nodes = f0.shape[1]
+    out = jnp.zeros_like(f0)
+    if 0 in lengths:
+        out = out + f0 * tmask[None, :]
+    f = f0
+    for length in range(1, (max(lengths) if lengths else 0) + 1):
+        per_edge = jnp.where(edge_ok[None, :], f[:, edge_src], 0)
+        f = jax.ops.segment_sum(per_edge.T, edge_dst,
+                                num_segments=n_nodes).T
+        if length == 2:
+            is_loop = edge_ok & (edge_src == edge_dst)
+            loops = jax.ops.segment_sum(is_loop.astype(f.dtype), edge_dst,
+                                        num_segments=n_nodes)
+            f = f - f0 * loops[None, :]
+        if length in lengths:
+            out = out + f * tmask[None, :]
+    return out
+
+
+@functools.lru_cache(maxsize=128)
+def ring_varexpand_cached(mesh: Mesh, n_nodes: int, lengths: tuple,
+                          axis: str = "shard"):
+    """Memoized make_ring_varexpand (compiled program reuse per shape)."""
+    return make_ring_varexpand(mesh, n_nodes, lengths, axis)
+
+
 @functools.lru_cache(maxsize=128)
 def ring_khop_cached(mesh: Mesh, n_nodes: int, n_hops: int,
                      axis: str = "shard", masked: bool = False):

@@ -395,11 +395,12 @@ class CountPatternOp(RelationalOperator):
         header, t = self.graph.scan_node("__cnt_n", labels)
         entry = None
         if isinstance(t, DeviceTable) and not t.is_local and t.capacity:
-            c = t._cols[header.column(E.Var("__cnt_n"))]
-            if c.kind in ("id", "int"):
+            col = header.column(E.Var("__cnt_n"))
+            host = t.host_column(col)
+            if host is not None:
+                c = t._cols[col]
                 static_ok = c.valid & t.row_ok
-                entry = (header, t, static_ok,
-                         np.asarray(c.data), np.asarray(static_ok))
+                entry = (header, t, static_ok, host[0], host[1])
         st["scans"][key] = entry
         return entry
 
@@ -414,11 +415,10 @@ class CountPatternOp(RelationalOperator):
         entry = None
         if isinstance(t, DeviceTable) and not t.is_local:
             v = E.Var("__cnt_r")
-            s = t._cols[header.column(E.StartNode(v))]
-            g = t._cols[header.column(E.EndNode(v))]
-            if s.kind in ("id", "int") and g.kind in ("id", "int"):
-                entry = (np.asarray(s.data), np.asarray(g.data),
-                         np.asarray(s.valid & g.valid & t.row_ok))
+            s = t.host_column(header.column(E.StartNode(v)))
+            g = t.host_column(header.column(E.EndNode(v)))
+            if s is not None and g is not None:
+                entry = (s[0], g[0], s[1] & g[1])
         st["rels"][rk] = entry
         return entry
 

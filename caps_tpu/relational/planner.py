@@ -37,13 +37,58 @@ class RelationalPlanner:
         self.current_graph = ambient_graph
         self._memo: Dict[L.LogicalOperator, R.RelationalOperator] = {}
         self._fresh = 0
+        # Names referenced anywhere in the plan (None = unknown, assume
+        # everything is used); lets VarExpand prove its rel var dead and
+        # take the ring-matrix path (var_expand.py module docstring).
+        self._used_names: Opt[frozenset] = None
 
     def fresh(self, prefix: str) -> str:
         self._fresh += 1
         return f"__{prefix}_{self._fresh}"
 
     def process(self, plan: L.LogicalPlan) -> R.RelationalOperator:
+        self._used_names = self._collect_used_names(plan.root)
         return self.plan_op(plan.root)
+
+    @staticmethod
+    def _collect_used_names(root: L.LogicalOperator) -> Opt[frozenset]:
+        """Every name read by an expression or selection in the plan.
+        Returns None (= treat all names as used) when the plan contains
+        operators whose name flow this walk doesn't model (CONSTRUCT
+        patterns carry var references outside the Expr tree)."""
+        used = set()
+        conservative = False
+
+        def walk(op):
+            nonlocal conservative
+            if isinstance(op, (L.ConstructGraph, L.ReturnGraph)):
+                conservative = True
+            exprs = []
+            if isinstance(op, L.Filter):
+                exprs.append(op.predicate)
+            elif isinstance(op, L.Project):
+                exprs.extend(e for _, e in op.items)
+            elif isinstance(op, L.Select):
+                used.update(op.names)
+            elif isinstance(op, L.Aggregate):
+                exprs.extend(e for _, e in op.group)
+                exprs.extend(a for _, a in op.aggregations)
+            elif isinstance(op, L.OrderBy):
+                exprs.extend(e for e, _ in op.items)
+            elif isinstance(op, (L.Skip, L.Limit)):
+                exprs.append(op.expr)
+            elif isinstance(op, L.Unwind):
+                exprs.append(op.list_expr)
+            elif isinstance(op, L.ValueJoin):
+                exprs.extend(op.predicates)
+            for e in exprs:
+                used.update(v.name for v in E.vars_in(e))
+            for c in op.children:
+                if isinstance(c, L.LogicalOperator):
+                    walk(c)
+
+        walk(root)
+        return None if conservative else frozenset(used)
 
     # ------------------------------------------------------------------
 
@@ -70,10 +115,12 @@ class RelationalPlanner:
             return self._plan_expand(op)
         if isinstance(op, L.BoundedVarLengthExpand):
             parent = self.plan_op(op.parent)
+            rel_needed = (self._used_names is None
+                          or op.rel in self._used_names)
             return VarExpandOp(
                 ctx, parent, self.current_graph, op.source, op.rel,
                 op.rel_types, op.target, op.target_labels, op.direction,
-                op.lower, op.upper, op.into)
+                op.lower, op.upper, op.into, rel_needed=rel_needed)
         if isinstance(op, L.Filter):
             return R.FilterOp(ctx, self.plan_op(op.parent), op.predicate)
         if isinstance(op, L.Project):

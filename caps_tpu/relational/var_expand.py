@@ -11,10 +11,21 @@ hop ids; lengths ``lower..upper`` are unioned, with traversed relationship
 ids packed into one list-valued column.  Static unrolling is deliberate —
 on the TPU backend every hop is a fixed-shape join the compiler can fuse,
 the device-side analog of ragged frontier schedules (SURVEY.md §5.7).
+
+On a device mesh, when the relationship variable is dead downstream (the
+planner proves it — no projection, filter, or return touches it), the op
+instead rides the ppermute RING schedule (parallel/ring.py,
+``make_ring_varexpand``): a per-seed path-count matrix rotates node blocks
+around the ICI against resident adjacency shards, and the (source, target,
+multiplicity) result is exploded back into rows — the general-frontier
+form of SURVEY.md §5.7's "frontier = long sequence" story.  Per-path
+relationship lists cannot ride this form; those queries stay on joins.
 """
 from __future__ import annotations
 
 from typing import List, Optional as Opt, Tuple
+
+import numpy as np
 
 from caps_tpu.ir import exprs as E
 from caps_tpu.ir.pattern import Direction
@@ -41,7 +52,8 @@ class VarExpandOp(RelationalOperator):
     def __init__(self, context, parent: RelationalOperator, graph,
                  source: str, rel: str, rel_types: Tuple[str, ...],
                  target: str, target_labels, direction: Direction,
-                 lower: int, upper: Opt[int], into: bool):
+                 lower: int, upper: Opt[int], into: bool,
+                 rel_needed: bool = True):
         super().__init__(context, [parent])
         self.graph = graph
         self.source = source
@@ -54,6 +66,10 @@ class VarExpandOp(RelationalOperator):
         self.upper = upper if upper is not None else max(
             lower, DEFAULT_UNBOUNDED_UPPER)
         self.into = into
+        # False = the planner proved no downstream operator reads the rel
+        # variable, so per-path relationship lists need not materialize.
+        self.rel_needed = rel_needed
+        self.strategy = "join"
 
     # ------------------------------------------------------------------
 
@@ -83,6 +99,157 @@ class VarExpandOp(RelationalOperator):
         return t.select([hid, hnear, hfar]), hid, hnear, hfar
 
     def _compute(self):
+        out = self._try_ring()
+        if out is None:
+            self.strategy = "join"
+            out = self._join_compute()
+        self._metric_extra = {"strategy": self.strategy}
+        return out
+
+    # -- ring-matrix path (mesh only; see module docstring) ----------------
+
+    # Refuse seed-matrix shapes beyond this many entries (int64 frontier
+    # blocks must fit comfortably in HBM across the mesh); larger inputs
+    # stay on the join path.  Seed-axis blocking is the scale-out path.
+    _RING_MAX_MATRIX = 1 << 24
+
+    @staticmethod
+    def _host_arrays(table, col: str):
+        """(values, ok) host copies of an integer column of a pure-device
+        table (DeviceTable.host_column), or None when there is no device
+        path."""
+        from caps_tpu.backends.tpu.table import DeviceTable
+        if not isinstance(table, DeviceTable):
+            return None
+        return table.host_column(col)
+
+    def _try_ring(self):
+        """Ring-scheduled var-expand (multiplicity form): returns the
+        (header, table) result, or None when the shape is ineligible."""
+        if (self.rel_needed or self.into
+                or self.direction == Direction.BOTH or self.upper > 2):
+            return None
+        backend = getattr(self.context.factory, "backend", None)
+        if (backend is None or backend.mesh is None
+                or not backend.config.use_ring):
+            return None
+        import jax.numpy as jnp
+        from caps_tpu.backends.tpu import kernels as K
+        from caps_tpu.backends.tpu.column import Column
+        from caps_tpu.backends.tpu.table import DeviceTable
+        from caps_tpu.okapi.types import CTInteger
+        from caps_tpu.parallel.ring import ring_varexpand_cached
+
+        parent_header, parent_table = self.children[0].result
+        src_id_col = parent_header.column(E.Var(self.source))
+        parent = self._host_arrays(parent_table, src_id_col)
+        if parent is None:
+            return None
+        rel_header, rel_t = self.graph.scan_rel("__ring_r", self.rel_types)
+        rv = E.Var("__ring_r")
+        rsrc = self._host_arrays(rel_t, rel_header.column(E.StartNode(rv)))
+        rtgt = self._host_arrays(rel_t, rel_header.column(E.EndNode(rv)))
+        tgt_header, tgt_table = self.graph.scan_node(
+            self.target, self.target_labels)
+        tgt_id_col = tgt_header.column(E.Var(self.target))
+        tids = self._host_arrays(tgt_table, tgt_id_col)
+        if rsrc is None or rtgt is None or tids is None:
+            return None
+
+        hsrc, hok = parent
+        esrc, eok1 = rsrc
+        etgt, eok2 = rtgt
+        eok = eok1 & eok2
+        nids, nok = tids
+        mx = -1
+        for vals, ok in ((hsrc, hok), (esrc, eok), (etgt, eok),
+                         (nids, nok)):
+            if vals.shape[0] and ok.any():
+                m = int(vals[ok].max())
+                if int(vals[ok].min()) < 0:
+                    return None
+                mx = max(mx, m)
+        n_shards = backend.n_shards
+        n_pad = max(((mx + 1 + n_shards - 1) // n_shards) * n_shards,
+                    n_shards)
+        seeds = np.unique(hsrc[hok])
+        n_seeds = int(seeds.shape[0])
+        if n_seeds * n_pad > self._RING_MAX_MATRIX:
+            return None
+        lengths = tuple(range(self.lower, self.upper + 1))
+        self.strategy = "ring-matrix"
+        rel_list_type = CTList(CTRelationship(self.rel_types))
+
+        if n_seeds == 0:
+            pairs = DeviceTable(backend, {
+                "__ring_src": Column("int", jnp.zeros(1, jnp.int64),
+                                     jnp.zeros(1, bool), CTInteger),
+                "__ring_tgt": Column("int", jnp.zeros(1, jnp.int64),
+                                     jnp.zeros(1, bool), CTInteger),
+            }, n=0)
+            return self._ring_assemble(parent_header, parent_table,
+                                       src_id_col, tgt_header, tgt_table,
+                                       tgt_id_col, pairs, rel_list_type)
+
+        # frontier seed-indicator matrix + target mask + padded edges
+        f0 = np.zeros((n_seeds, n_pad), dtype=np.int64)
+        f0[np.arange(n_seeds), seeds] = 1
+        tmask = np.zeros(n_pad, dtype=np.int64)
+        tmask[nids[nok]] = 1
+        e_pad = max((((esrc.shape[0] + n_shards - 1) // n_shards)
+                     * n_shards), n_shards)
+        frm = np.zeros(e_pad, dtype=np.int32)
+        to = np.zeros(e_pad, dtype=np.int32)
+        okp = np.zeros(e_pad, dtype=bool)
+        a, b = (esrc, etgt) if self.direction == Direction.OUTGOING \
+            else (etgt, esrc)
+        frm[:a.shape[0]] = np.where(eok, a, 0)
+        to[:b.shape[0]] = np.where(eok, b, 0)
+        okp[:eok.shape[0]] = eok
+
+        fn = ring_varexpand_cached(backend.mesh, n_pad, lengths,
+                                   backend.axis)
+        m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
+               jnp.asarray(okp), jnp.asarray(tmask))
+        counts = m.reshape(-1)
+        total = backend.consume_count(counts.sum())
+        out_cap = backend.bucket(total)
+        row, _within, valid, _tot = K.explode_expand(
+            counts, jnp.ones_like(counts, dtype=bool), out_cap)
+        s_idx = row // n_pad
+        v = row % n_pad
+        src_ids = jnp.asarray(seeds.astype(np.int64))[s_idx]
+        pairs = DeviceTable(backend, {
+            "__ring_src": Column("int", backend.place_rows(src_ids),
+                                 backend.place_rows(valid), CTInteger),
+            "__ring_tgt": Column("int",
+                                 backend.place_rows(v.astype(jnp.int64)),
+                                 backend.place_rows(valid), CTInteger),
+        }, n=total)
+        return self._ring_assemble(parent_header, parent_table, src_id_col,
+                                   tgt_header, tgt_table, tgt_id_col, pairs,
+                                   rel_list_type)
+
+    def _ring_assemble(self, parent_header, parent_table, src_id_col,
+                       tgt_header, tgt_table, tgt_id_col, pairs,
+                       rel_list_type):
+        """(source, target) multiplicity rows -> the join path's exact
+        output schema: parent columns + null rel-list + target columns."""
+        joined = parent_table.join(pairs, "inner",
+                                   [(src_id_col, "__ring_src")])
+        tt = tgt_table.rename({c: f"__t_{c}" for c in tgt_table.columns})
+        joined = joined.join(tt, "inner",
+                             [("__ring_tgt", f"__t_{tgt_id_col}")])
+        joined = joined.rename({f"__t_{c}": c for c in tgt_table.columns})
+        joined = joined.with_literal_column(self.rel, None, rel_list_type)
+        out_header = parent_header.with_expr(E.Var(self.rel), rel_list_type,
+                                             column=self.rel)
+        out_header = out_header.concat(tgt_header)
+        return out_header, joined.select(list(out_header.columns))
+
+    # -- join path (the general form) --------------------------------------
+
+    def _join_compute(self):
         parent_header, parent_table = self.children[0].result
         params = self.context.parameters
         rel_list_type: CypherType = CTList(CTRelationship(self.rel_types))
