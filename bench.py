@@ -292,6 +292,11 @@ def main():
         n_people, n_edges, n_seeds, iters = 1_000_000, 5_000_000, 100, 10
     else:  # CPU fallback: ~10x smaller so the whole run fits the budget
         n_people, n_edges, n_seeds, iters = 20_000, 100_000, 20, 3
+    # Same-shape override for honest TPU-vs-CPU comparisons
+    # (BENCH_N_PEOPLE/BENCH_N_EDGES; the advisor asked for reconcilable
+    # cross-backend numbers — shapes differ by default for budget reasons)
+    n_people = int(os.environ.get("BENCH_N_PEOPLE", n_people))
+    n_edges = int(os.environ.get("BENCH_N_EDGES", n_edges))
 
     tpu_session = TPUCypherSession()
     graph, src, dst, names = build_graph(tpu_session, n_people, n_edges,

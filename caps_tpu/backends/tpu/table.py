@@ -383,7 +383,7 @@ class DeviceTable(Table):
             counts, lo = K.probe_count(self._join_key(lcol), l_ok, rk_sorted)
         total = self.backend.consume_count(K.join_total(counts, l_ok, left_join))
         out_cap = self.backend.bucket(total)
-        if self.backend.config.use_pallas and OPS.pallas_usable():
+        if self.backend.config.use_pallas and OPS.pallas_usable("prefetch"):
             l_idx, r_idx, out_valid, r_matched = OPS.join_expand_via_positions(
                 counts, lo, perm, l_ok, out_cap, left_join,
                 interpret=OPS.default_interpret())
@@ -456,6 +456,21 @@ class DeviceTable(Table):
                                      a.ctype.join(b.ctype))
         return DeviceTable(self.backend, out, total)
 
+    def _sort_perm(self, keys: List[jnp.ndarray]) -> jnp.ndarray:
+        """Stable multi-key sort permutation: the Pallas bitonic kernel
+        on supported tile capacities (compiled TPU only — in interpreter
+        mode the 105-stage network is far slower than lax.sort), the
+        lax.sort twin otherwise."""
+        cap = self.capacity
+        from caps_tpu.ops import sort as S
+        cfg = self.backend.config
+        if (cfg.use_pallas and cfg.use_sort_kernel
+                and S.sort_cap_supported(cap)
+                and jax.default_backend() == "tpu"
+                and OPS.pallas_usable("sort")):
+            return S.sort_perm_pallas(keys, cap)
+        return K.sort_perm(keys, cap)
+
     def distinct(self) -> "DeviceTable":
         if self._local is not None:
             return self._wrap_local(self._local.distinct())
@@ -464,7 +479,7 @@ class DeviceTable(Table):
             for col in self._cols.values():
                 keys.extend(_sort_keys(col, ascending=True,
                                        nulls_last=True, pool=self.backend.pool))
-            perm = K.sort_perm(keys, self.capacity)
+            perm = self._sort_perm(keys)
         except UnsupportedOnDevice as ex:
             return self._fallback(str(ex)).distinct()
         sorted_cols = _gather_cols(self._cols, perm)
@@ -482,7 +497,7 @@ class DeviceTable(Table):
                 col = self._cols[col_name]
                 keys.extend(_sort_keys(col, ascending=asc, nulls_last=asc,
                                        pool=self.backend.pool))
-            perm = K.sort_perm(keys, self.capacity)
+            perm = self._sort_perm(keys)
         except UnsupportedOnDevice as ex:
             return self._fallback(str(ex)).order_by(items)
         return DeviceTable(self.backend, _gather_cols(self._cols, perm),
@@ -530,7 +545,7 @@ class DeviceTable(Table):
             keys = [(~self.row_ok).astype(jnp.int64)]
             for c in by:
                 keys.extend(_sort_keys(self._cols[c], True, True, pool))
-            perm = K.sort_perm(keys, cap)
+            perm = self._sort_perm(keys)
             sorted_cols = _gather_cols(self._cols, perm)
             change = K.neighbor_change_keys(
                 [k[perm] for k in keys[1:]]) & K.row_mask(cap, self._n)
@@ -570,7 +585,7 @@ class DeviceTable(Table):
                 col = sorted_cols[col_name]
                 vk = _sort_keys(col, True, True, pool)
                 combined = group_keys_sorted + vk
-                p2 = K.sort_perm(combined, cap)
+                p2 = self._sort_perm(combined)
                 ch2 = K.neighbor_change_keys([k[p2] for k in combined])
                 firstocc_cache[col_name] = \
                     jnp.zeros(cap, bool).at[p2].set(ch2)
@@ -592,7 +607,7 @@ class DeviceTable(Table):
         Returns None when the shape doesn't fit (engine falls back to the
         sorted path)."""
         cfg = self.backend.config
-        if not cfg.use_pallas or not OPS.pallas_usable() or len(by) != 1:
+        if not cfg.use_pallas or not OPS.pallas_usable("basic") or len(by) != 1:
             return None
         if any(a.distinct or a.kind == "collect" for a in aggs):
             return None  # sorted path handles distinct/collect

@@ -47,6 +47,14 @@ class EngineConfig:
     # Kernel switches (pallas kernels fall back to jnp when off)
     use_pallas: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_PALLAS", True))
+    # Bitonic sort-permutation kernel (ops/sort.py) for order_by /
+    # distinct / group sorts on supported tile capacities (compiled TPU
+    # only; rides use_pallas + the probe's "sort" family).  Default OFF:
+    # compiled-path validation on the live TPU stack is still pending
+    # (the tunnel wedged mid-validation); flip on once a recorded
+    # compile+parity run exists for the active jaxlib.
+    use_sort_kernel: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_SORT_KERNEL", False))
     # HBM-resident CSR adjacency as the relationship scan's physical
     # layout (ops/expand.py DeviceCSR); joins against it probe indptr
     # instead of sorting + binary-searching the edge table.

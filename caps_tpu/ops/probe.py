@@ -2,12 +2,28 @@
 
 The engine's Pallas kernels are differential-tested in interpreter mode
 everywhere, but whether they *compile* on the active TPU stack depends on
-the toolchain (e.g. remote-compile transports may reject scalar-prefetch
-grids, or hang on specific kernel shapes).  A broken kernel must degrade
-to its jnp twin, never crash or wedge a query — so the first compiled use
-is gated by a one-time probe that builds representative kernels in a
-subprocess (immune to compiler hangs) and caches the verdict on disk per
-jaxlib version.
+the toolchain — and not uniformly: remote-compile transports have been
+observed to reject scalar-prefetch grids (and 1-D blocked operands) while
+compiling plain-grid and full-tile kernels fine.  A broken kernel must
+degrade to its jnp twin, never crash or wedge a query — so the first
+compiled use is gated by a one-time probe that builds one representative
+kernel per FEATURE FAMILY, each in its OWN subprocess (immune to compiler
+hangs, and a hang in one family cannot condemn the others), and caches
+per-family verdicts on disk per jaxlib version:
+
+    basic    — plain grid, full-array/2-D blocks, iota/compare/reduce
+               (segment histogram, join-expand positions)
+    prefetch — PrefetchScalarGridSpec with data-dependent block indexing
+               (the CSR expand-positions kernel)
+    sort     — grid-stepped compare-exchange with sublane reshape/concat
+               swaps + tile transposes (the bitonic sort kernel)
+
+A subprocess that failed WITHOUT a Pallas/Mosaic-shaped error (e.g. it
+could not acquire an exclusively-held device) does not condemn the
+family — the probe retries in-process, where only quick failure modes
+can occur (hang-prone families skip the retry and stay unknown=False for
+this process WITHOUT writing the disk cache, so a healthy later process
+re-probes).
 """
 from __future__ import annotations
 
@@ -15,19 +31,24 @@ import json
 import os
 import subprocess
 import sys
-from typing import Optional
+from typing import Dict, Optional
 
-_VERDICT: Optional[bool] = None
+FEATURES = ("basic", "prefetch", "sort")
 
-_PROBE_SRC = r"""
+_VERDICT: Optional[Dict[str, bool]] = None
+
+_COMMON = r"""
 import jax
 jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp
 import functools
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+"""
 
-# family 1: plain grid + iota/compare/reduce (segment aggregation shape)
+_PROBE_SRCS = {
+    # plain grid + iota/compare/reduce (segment aggregation shape)
+    "basic": _COMMON + r"""
 def k1(x_ref, o_ref):
     t = jax.lax.broadcasted_iota(jnp.int32, (256, 128), 1)
     offs = x_ref[:].reshape(256, 1)
@@ -36,8 +57,10 @@ def k1(x_ref, o_ref):
 x = jnp.arange(256, dtype=jnp.int32)
 out = pl.pallas_call(k1, out_shape=jax.ShapeDtypeStruct((256,), jnp.int32))(x)
 out.block_until_ready()
-
-# family 2: scalar-prefetch grid with data-dependent block indexing
+print("PALLAS_PROBE_OK", flush=True)
+""",
+    # scalar-prefetch grid with data-dependent block indexing
+    "prefetch": _COMMON + r"""
 def k2(blk_ref, x_ref, o_ref):
     o_ref[:] = x_ref[:] * 2
 tile, n_tiles = 256, 4
@@ -55,90 +78,73 @@ out2 = pl.pallas_call(k2, grid_spec=grid_spec,
                       out_shape=[jax.ShapeDtypeStruct((tile * n_tiles,),
                                                       jnp.int32)])(blk, xs)
 out2[0].block_until_ready()
-print("PALLAS_PROBE_OK")
-"""
+print("PALLAS_PROBE_OK", flush=True)
+""",
+    # the real sort kernel at its smallest capacity (grid-stepped
+    # compare-exchange, reshape/concat swaps, transposes, revisited
+    # aliased blocks) — representative mini-kernels have proven too
+    # optimistic for this family, so probe the thing itself
+    "sort": _COMMON + r"""
+import sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+from caps_tpu.ops.sort import sort_perm_pallas
+from caps_tpu.backends.tpu import kernels as K
+rng = np.random.RandomState(0)
+keys = [jnp.asarray(rng.randint(0, 50, 256).astype(np.int64))]
+got = sort_perm_pallas(keys, 256)
+got.block_until_ready()
+want = K.sort_perm(keys, 256)
+np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+print("PALLAS_PROBE_OK", flush=True)
+""" % {"repo": os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))},
+}
+
+_MARKER = "PALLAS_PROBE_OK"
+
+# families safe to retry in-process (fail fast, no observed hangs)
+_INPROCESS_RETRY = ("basic",)
+
+_PALLAS_ERR_MARKERS = ("pallas", "mosaic", "RecursionError",
+                       "remote_compile", "tpu_compile")
 
 
 def _cache_path() -> str:
     import jaxlib
     ver = getattr(jaxlib, "__version__", "unknown")
     return os.path.join(os.path.expanduser("~"), ".cache",
-                        f"caps_tpu_pallas_probe_{ver}.json")
+                        f"caps_tpu_pallas_probe3_{ver}.json")
 
 
-_PALLAS_ERR_MARKERS = ("pallas", "mosaic", "RecursionError",
-                       "remote_compile", "tpu_compile")
-
-
-def pallas_usable(timeout_s: float = 180.0) -> bool:
-    """True if compiled Pallas kernels work on the default backend.
-
-    Non-TPU backends always return True (kernels run in interpreter mode
-    there).  On TPU the verdict comes from a subprocess probe, cached in
-    memory and on disk.  ``CAPS_TPU_PALLAS_PROBE=1`` / ``0`` overrides
-    the probe entirely (and is the recovery knob for a stale cached
-    verdict — delete the cache file or set the env).  A subprocess that
-    failed WITHOUT a Pallas/Mosaic-shaped error (e.g. it could not
-    acquire an exclusively-held local device) does not condemn the
-    stack — the probe retries in-process, where only the quick failure
-    modes can occur.
-    """
-    global _VERDICT
-    override = os.environ.get("CAPS_TPU_PALLAS_PROBE")
-    if override is not None:
-        return override.strip().lower() in ("1", "true", "yes", "on")
-    if _VERDICT is not None:
-        return _VERDICT
-    import jax
-    if jax.default_backend() != "tpu":
-        _VERDICT = True
-        return True
-    path = _cache_path()
+def _probe_family(feature: str, timeout_s: float):
+    """(verdict, reason, conclusive): run one family in a subprocess.
+    Non-conclusive failures (no Pallas-shaped error) must not be written
+    to the disk cache."""
     try:
-        with open(path) as f:
-            _VERDICT = bool(json.load(f)["usable"])
-            return _VERDICT
-    except Exception:
-        pass
-    reason = ""
-    try:
-        proc = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+        proc = subprocess.run([sys.executable, "-c", _PROBE_SRCS[feature]],
                               capture_output=True, text=True,
                               timeout=timeout_s)
-        ok = proc.returncode == 0 and "PALLAS_PROBE_OK" in proc.stdout
-        if not ok:
-            err = (proc.stderr or "") + (proc.stdout or "")
-            reason = err[-500:]
-            if not any(m.lower() in err.lower()
-                       for m in _PALLAS_ERR_MARKERS):
-                # failure unrelated to Pallas (device contention, env):
-                # probe in-process — crash-style failures raise quickly
-                ok, reason = _probe_inprocess()
+        if proc.returncode == 0 and _MARKER in (proc.stdout or ""):
+            return True, "", True
+        err = (proc.stderr or "") + (proc.stdout or "")
+        pallas_shaped = any(m.lower() in err.lower()
+                            for m in _PALLAS_ERR_MARKERS)
+        if not pallas_shaped and feature in _INPROCESS_RETRY:
+            ok, reason = _probe_basic_inprocess()
+            return ok, reason, True
+        return False, err[-400:], pallas_shaped
     except subprocess.TimeoutExpired:
-        ok, reason = False, f"probe timed out after {timeout_s}s"
-    except Exception as ex:
-        ok, reason = _probe_inprocess()
-        reason = reason or str(ex)
-    if not ok:
-        import logging
-        logging.getLogger("caps_tpu").warning(
-            "compiled Pallas kernels disabled on this TPU stack "
-            "(falling back to jnp twins): %s — override with "
-            "CAPS_TPU_PALLAS_PROBE=1 or delete %s", reason.strip()[:200],
-            path)
-    _VERDICT = ok
-    try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump({"usable": ok, "reason": reason.strip()[:500]}, f)
-    except Exception:
-        pass
-    return ok
+        # a compiler hang IS a verdict for the hang-prone families
+        return False, f"probe timed out after {timeout_s}s", True
+    except Exception as ex:  # environment failure — not conclusive
+        return False, str(ex)[:400], False
 
 
-def _probe_inprocess():
-    """Last-resort probe in this process (no hang protection; used only
-    when the subprocess failed for reasons unrelated to Pallas)."""
+def _probe_basic_inprocess():
+    """Last-resort basic-family probe in this process (no hang
+    protection; used only when the subprocess failed for reasons
+    unrelated to Pallas, e.g. device contention)."""
     try:
         import jax
         import jax.numpy as jnp
@@ -154,30 +160,81 @@ def _probe_inprocess():
         pl.pallas_call(
             k1, out_shape=jax.ShapeDtypeStruct((256,), jnp.int32)
         )(x).block_until_ready()
-
-        # scalar-prefetch grids are the feature remote-compile stacks
-        # reject; the engine's expand kernel needs them
-        from jax.experimental.pallas import tpu as pltpu
-
-        def k2(blk_ref, x_ref, o_ref):
-            o_ref[:] = x_ref[:] * 2
-
-        tile, n_tiles = 256, 4
-        xs = jnp.arange(tile * n_tiles, dtype=jnp.int32)
-        blk = jnp.arange(n_tiles, dtype=jnp.int32)
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(n_tiles,),
-            in_specs=[pl.BlockSpec((tile,), lambda i, b: (b[i],),
-                                   memory_space=pltpu.VMEM)],
-            out_specs=[pl.BlockSpec((tile,), lambda i, b: (i,),
-                                    memory_space=pltpu.VMEM)],
-        )
-        out = pl.pallas_call(
-            k2, grid_spec=grid_spec,
-            out_shape=[jax.ShapeDtypeStruct((tile * n_tiles,), jnp.int32)],
-        )(blk, xs)
-        out[0].block_until_ready()
         return True, ""
     except Exception as ex:
-        return False, str(ex)[:500]
+        return False, str(ex)[:400]
+
+
+def pallas_usable(feature: str = "basic", timeout_s: float = 240.0) -> bool:
+    """True if compiled Pallas kernels of this feature family work on the
+    default backend.
+
+    Non-TPU backends always return True (kernels run in interpreter mode
+    there).  On TPU the verdicts come from per-family subprocess probes,
+    cached in memory and on disk.  ``CAPS_TPU_PALLAS_PROBE=1`` / ``0``
+    overrides every family (and is the recovery knob for a stale cached
+    verdict — delete the cache file or set the env)."""
+    assert feature in FEATURES, feature
+    global _VERDICT
+    override = os.environ.get("CAPS_TPU_PALLAS_PROBE")
+    if override is not None:
+        return override.strip().lower() in ("1", "true", "yes", "on")
+    if _VERDICT is not None:
+        return _VERDICT[feature]
+    import jax
+    if jax.default_backend() != "tpu":
+        _VERDICT = {f: True for f in FEATURES}
+        return True
+    path = _cache_path()
+    try:
+        with open(path) as f:
+            cached = json.load(f)
+            _VERDICT = {k: bool(cached[k]) for k in FEATURES}
+            return _VERDICT[feature]
+    except Exception:
+        pass
+    # Device sanity first: when the device/tunnel itself is wedged, every
+    # family would "time out" — that is a verdict about the transport,
+    # not the compiler, and must never be cached as one.
+    sane = True
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "print(int(jnp.arange(8).sum()))"],
+            capture_output=True, text=True, timeout=90.0)
+        sane = proc.returncode == 0
+    except Exception:
+        sane = False
+    if not sane:
+        _VERDICT = {f: False for f in FEATURES}
+        return False  # in-memory only; a healthy process re-probes
+
+    verdict, reasons, conclusive = {}, {}, True
+    for fam in FEATURES:
+        ok, reason, concl = _probe_family(fam, timeout_s)
+        verdict[fam] = ok
+        if reason:
+            reasons[fam] = reason
+        conclusive = conclusive and concl
+    disabled = [f for f in FEATURES if not verdict[f]]
+    if disabled:
+        import logging
+        logging.getLogger("caps_tpu").warning(
+            "compiled Pallas kernel families %s disabled on this TPU stack "
+            "(falling back to jnp twins): %s — override with "
+            "CAPS_TPU_PALLAS_PROBE=1 or delete %s", disabled,
+            {k: v[:120] for k, v in reasons.items()}, path)
+    _VERDICT = verdict
+    if conclusive:
+        # inconclusive verdicts (device contention, env) stay in-memory
+        # only, so a healthy later process re-probes
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                json.dump({**verdict,
+                           "reasons": {k: v[:400]
+                                       for k, v in reasons.items()}}, f)
+        except Exception:
+            pass
+    return _VERDICT[feature]
