@@ -165,76 +165,105 @@ def _probe_basic_inprocess():
         return False, str(ex)[:400]
 
 
+_SANE: Optional[bool] = None
+
+
+def _device_sane() -> bool:
+    """Can a THROWAWAY subprocess reach the device?  False either when
+    the device/tunnel is wedged (family timeouts would be transport
+    verdicts, not compiler ones) or when this process holds the device
+    exclusively (subprocess probes can't run; in-process can)."""
+    global _SANE
+    if _SANE is None:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; "
+                 "print(int(jnp.arange(8).sum()))"],
+                capture_output=True, text=True, timeout=90.0)
+            _SANE = proc.returncode == 0
+        except Exception:
+            _SANE = False
+    return _SANE
+
+
 def pallas_usable(feature: str = "basic", timeout_s: float = 240.0) -> bool:
     """True if compiled Pallas kernels of this feature family work on the
     default backend.
 
     Non-TPU backends always return True (kernels run in interpreter mode
-    there).  On TPU the verdicts come from per-family subprocess probes,
-    cached in memory and on disk.  ``CAPS_TPU_PALLAS_PROBE=1`` / ``0``
-    overrides every family (and is the recovery knob for a stale cached
-    verdict — delete the cache file or set the env)."""
+    there).  On TPU each family is probed LAZILY on first request — a
+    config-gated family (e.g. the sort kernel behind use_sort_kernel)
+    costs nothing until something actually asks for it — and verdicts
+    are cached in memory and merged per-family into the on-disk cache.
+    ``CAPS_TPU_PALLAS_PROBE=1`` / ``0`` overrides every family (and is
+    the recovery knob for a stale cached verdict — delete the cache file
+    or set the env)."""
     assert feature in FEATURES, feature
     global _VERDICT
     override = os.environ.get("CAPS_TPU_PALLAS_PROBE")
     if override is not None:
         return override.strip().lower() in ("1", "true", "yes", "on")
-    if _VERDICT is not None:
+    if _VERDICT is None:
+        _VERDICT = {}
+    if feature in _VERDICT:
         return _VERDICT[feature]
     import jax
     if jax.default_backend() != "tpu":
-        _VERDICT = {f: True for f in FEATURES}
+        for f in FEATURES:
+            _VERDICT[f] = True
         return True
     path = _cache_path()
+    cached = {}
     try:
         with open(path) as f:
             cached = json.load(f)
-            _VERDICT = {k: bool(cached[k]) for k in FEATURES}
-            return _VERDICT[feature]
     except Exception:
         pass
-    # Device sanity first: when the device/tunnel itself is wedged, every
-    # family would "time out" — that is a verdict about the transport,
-    # not the compiler, and must never be cached as one.
-    sane = True
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp; "
-             "print(int(jnp.arange(8).sum()))"],
-            capture_output=True, text=True, timeout=90.0)
-        sane = proc.returncode == 0
-    except Exception:
-        sane = False
-    if not sane:
-        _VERDICT = {f: False for f in FEATURES}
-        return False  # in-memory only; a healthy process re-probes
+    if feature in cached:
+        _VERDICT[feature] = bool(cached[feature])
+        return _VERDICT[feature]
 
-    verdict, reasons, conclusive = {}, {}, True
-    for fam in FEATURES:
-        ok, reason, concl = _probe_family(fam, timeout_s)
-        verdict[fam] = ok
-        if reason:
-            reasons[fam] = reason
-        conclusive = conclusive and concl
-    disabled = [f for f in FEATURES if not verdict[f]]
-    if disabled:
-        import logging
-        logging.getLogger("caps_tpu").warning(
-            "compiled Pallas kernel families %s disabled on this TPU stack "
+    import logging
+    log = logging.getLogger("caps_tpu")
+    if not _device_sane():
+        # Either the transport is wedged (nothing conclusive can be
+        # learned) or this process holds the device exclusively — the
+        # case the in-process basic probe recovers.  Never disk-cache.
+        if feature in _INPROCESS_RETRY:
+            ok, reason = _probe_basic_inprocess()
+        else:
+            ok, reason = False, ("device unreachable from probe "
+                                 "subprocess (wedged transport or "
+                                 "exclusively-held device)")
+        if not ok:
+            log.warning(
+                "compiled Pallas %r kernels disabled for this process "
+                "(not cached): %s — override with CAPS_TPU_PALLAS_PROBE=1",
+                feature, reason.strip()[:200])
+        _VERDICT[feature] = ok
+        return ok
+
+    ok, reason, conclusive = _probe_family(feature, timeout_s)
+    if not ok:
+        log.warning(
+            "compiled Pallas %r kernels disabled on this TPU stack "
             "(falling back to jnp twins): %s — override with "
-            "CAPS_TPU_PALLAS_PROBE=1 or delete %s", disabled,
-            {k: v[:120] for k, v in reasons.items()}, path)
-    _VERDICT = verdict
+            "CAPS_TPU_PALLAS_PROBE=1 or delete %s", feature,
+            reason.strip()[:200], path)
+    _VERDICT[feature] = ok
     if conclusive:
-        # inconclusive verdicts (device contention, env) stay in-memory
-        # only, so a healthy later process re-probes
+        # merge this family's verdict; inconclusive ones (contention,
+        # env) stay in-memory only so a healthy later process re-probes
         try:
+            cached[feature] = ok
+            reasons = dict(cached.get("reasons", {}))
+            if reason:
+                reasons[feature] = reason.strip()[:400]
+            cached["reasons"] = reasons
             os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(path, "w") as f:
-                json.dump({**verdict,
-                           "reasons": {k: v[:400]
-                                       for k, v in reasons.items()}}, f)
+                json.dump(cached, f)
         except Exception:
             pass
-    return _VERDICT[feature]
+    return ok

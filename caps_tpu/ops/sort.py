@@ -84,12 +84,30 @@ def _partner(x: jnp.ndarray, d: int, rows: int) -> jnp.ndarray:
     return _swap_rows(x.T, d // rows).T
 
 
+def _exchange_step(planes: List[jnp.ndarray], i_mat: jnp.ndarray,
+                   dir_bit: jnp.ndarray, d: int,
+                   rows: int) -> List[jnp.ndarray]:
+    """One compare-exchange stage at static distance ``d`` — THE shared
+    comparator body: both the XLA twin (differential tests) and the
+    Pallas kernel call exactly this, so the tests validate the kernel's
+    logic, not a copy."""
+    partners = [_partner(p, d, rows) for p in planes]
+    gt = jnp.zeros((rows, LANES), jnp.bool_)
+    eq = jnp.ones((rows, LANES), jnp.bool_)
+    for a, b in zip(planes, partners):
+        gt = gt | (eq & (a > b))
+        eq = eq & (a == b)
+    take_min = ((i_mat & d) == 0) ^ (dir_bit == 1)
+    sel_p = jnp.where(take_min, gt, ~gt)
+    return [jnp.where(sel_p, pb, pa) for pa, pb in zip(planes, partners)]
+
+
 def _network(planes: List[jnp.ndarray], rows: int,
              total_levels: int) -> jnp.ndarray:
     """The full bitonic network on (rows, 128) tiles; returns the
-    original-position payload tile.  Pure jnp — the Pallas kernel runs
-    it on VMEM-loaded refs; the CPU twin and the differential tests run
-    it directly under XLA."""
+    original-position payload tile.  Pure jnp — the CPU twin and the
+    differential tests run it directly under XLA; the Pallas kernel
+    steps the same _exchange_step per grid step."""
     # running original-position payload; also the final comparator
     # tiebreaker, which makes the order strict (=> stable network)
     r_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
@@ -101,16 +119,7 @@ def _network(planes: List[jnp.ndarray], rows: int,
         dir_bit = (i_mat >> m) & 1  # 1 = descending block this level
         d = 1 << (m - 1)
         while d >= 1:
-            partners = [_partner(p, d, rows) for p in planes]
-            gt = jnp.zeros((rows, LANES), jnp.bool_)
-            eq = jnp.ones((rows, LANES), jnp.bool_)
-            for a, b in zip(planes, partners):
-                gt = gt | (eq & (a > b))
-                eq = eq & (a == b)
-            take_min = ((i_mat & d) == 0) ^ (dir_bit == 1)
-            sel_p = jnp.where(take_min, gt, ~gt)
-            planes = [jnp.where(sel_p, pb, pa)
-                      for pa, pb in zip(planes, partners)]
+            planes = _exchange_step(planes, i_mat, dir_bit, d, rows)
             d //= 2
     return planes[-1]
 
@@ -150,17 +159,9 @@ def _stage_kernel(*refs, rows: int, total_levels: int):
         for k in range(total_levels):
             @pl.when(k_idx == k)
             def _exchange(k=k):
-                d = 1 << k
-                partners = [_partner(p, d, rows) for p in planes]
-                gt = jnp.zeros((rows, LANES), jnp.bool_)
-                eq = jnp.ones((rows, LANES), jnp.bool_)
-                for a, b in zip(planes, partners):
-                    gt = gt | (eq & (a > b))
-                    eq = eq & (a == b)
-                take_min = ((i_mat & d) == 0) ^ (dir_bit == 1)
-                sel_p = jnp.where(take_min, gt, ~gt)
-                for o_ref, pa, pb in zip(out_refs, planes, partners):
-                    o_ref[:, :] = jnp.where(sel_p, pb, pa)
+                new = _exchange_step(planes, i_mat, dir_bit, 1 << k, rows)
+                for o_ref, p in zip(out_refs, new):
+                    o_ref[:, :] = p
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
