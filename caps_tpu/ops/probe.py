@@ -218,6 +218,8 @@ def pallas_usable(feature: str = "basic", timeout_s: float = 240.0) -> bool:
     try:
         with open(path) as f:
             cached = json.load(f)
+        if not isinstance(cached, dict):
+            cached = {}  # corrupt cache: self-heal on next write
     except Exception:
         pass
     if feature in cached:
@@ -227,22 +229,20 @@ def pallas_usable(feature: str = "basic", timeout_s: float = 240.0) -> bool:
     import logging
     log = logging.getLogger("caps_tpu")
     if not _device_sane():
-        # Either the transport is wedged (nothing conclusive can be
-        # learned) or this process holds the device exclusively — the
-        # case the in-process basic probe recovers.  Never disk-cache.
-        if feature in _INPROCESS_RETRY:
-            ok, reason = _probe_basic_inprocess()
-        else:
-            ok, reason = False, ("device unreachable from probe "
-                                 "subprocess (wedged transport or "
-                                 "exclusively-held device)")
-        if not ok:
-            log.warning(
-                "compiled Pallas %r kernels disabled for this process "
-                "(not cached): %s — override with CAPS_TPU_PALLAS_PROBE=1",
-                feature, reason.strip()[:200])
-        _VERDICT[feature] = ok
-        return ok
+        # Unreachable from a subprocess: wedged transport or an
+        # exclusively-held device.  The two are indistinguishable from
+        # here, and an in-process attempt would hang forever on a wedged
+        # transport (block_until_ready is not interruptible), so the
+        # only safe verdict is False — in-memory ONLY, never cached; a
+        # healthy later process re-probes, and CAPS_TPU_PALLAS_PROBE=1
+        # is the documented override for exclusive-hold stacks.
+        log.warning(
+            "compiled Pallas %r kernels disabled for this process "
+            "(not cached): device unreachable from probe subprocess "
+            "(wedged transport or exclusively-held device) — override "
+            "with CAPS_TPU_PALLAS_PROBE=1", feature)
+        _VERDICT[feature] = False
+        return False
 
     ok, reason, conclusive = _probe_family(feature, timeout_s)
     if not ok:

@@ -146,19 +146,31 @@ def _ring_hop_matrix(f_block, edge_src, edge_dst, edge_ok, *, axis: str,
 
 
 def make_ring_varexpand(mesh: Mesh, n_nodes: int, lengths: tuple,
-                        axis: str = "shard"):
+                        axis: str = "shard", correction: str = "loops"):
     """Jitted ring-scheduled var-length expand: per-seed PATH-count matrix
     over the union of ``lengths`` (each in 0..2), with the relationship-
-    isomorphism correction applied at length 2 (the only invalid length-2
-    walk under a uniform direction is a self-loop edge reused immediately,
-    so paths2 = walks2 - diag(self-loop count)).  Inputs arrive sharded:
-    the seed-indicator matrix F0 (seeds, n_nodes) node-block sharded on
-    its node axis, edges edge-sharded, the target-node mask node-block
-    sharded.  Output is the (seeds, n_nodes) multiplicity matrix M[s, v] =
-    #paths seed_s ->..-> v with len in ``lengths`` and v in the mask."""
+    isomorphism correction applied at length 2.  ``correction`` names the
+    invalid-walk structure of the edge list:
+
+      * ``"loops"`` (uniform OUT/IN direction): the only length-2 walk
+        reusing its relationship is a self-loop taken twice — subtract
+        the per-node self-loop count on the diagonal;
+      * ``"degree"`` (undirected — the edge list arrives symmetrized,
+        self-loops once): every incident edge yields exactly one
+        there-and-back walk s -e- m -e- s — subtract the per-node count
+        of symmetrized edges leaving the node (which counts non-loop
+        incident edges once per endpoint and self-loops once).
+
+    Inputs arrive sharded: the seed-indicator matrix F0 (seeds, n_nodes)
+    node-block sharded on its node axis, edges edge-sharded, the
+    target-node mask node-block sharded.  Output is the (seeds, n_nodes)
+    multiplicity matrix M[s, v] = #paths seed_s ->..-> v with len in
+    ``lengths`` and v in the mask."""
     n_shards = int(mesh.devices.size)
     if n_nodes % n_shards:
         raise ValueError(f"n_nodes {n_nodes} must divide over {n_shards}")
+    if correction not in ("loops", "degree"):
+        raise ValueError(correction)
     max_len = max(lengths) if lengths else 0
     if max_len > 2:
         raise ValueError("ring var-expand supports lengths <= 2")
@@ -173,15 +185,18 @@ def make_ring_varexpand(mesh: Mesh, n_nodes: int, lengths: tuple,
         for length in range(1, max_len + 1):
             f = hop(f, edge_src, edge_dst, edge_ok)
             if length == 2:
-                # isomorphism correction: the walk s -e-> s -e-> s (e a
-                # self-loop at s) reuses its relationship; remove one walk
-                # per self-loop, landing on the diagonal — F0 * loops[v].
-                is_loop = edge_ok & (edge_src == edge_dst)
+                # relationship-isomorphism correction on the diagonal
+                # (see docstring); counted by src so both modes share
+                # one collective
+                if correction == "loops":
+                    bad = edge_ok & (edge_src == edge_dst)
+                else:
+                    bad = edge_ok
                 loc = jax.ops.segment_sum(
-                    is_loop.astype(f.dtype), edge_dst, num_segments=n_nodes)
-                loops = jax.lax.psum_scatter(loc, axis, scatter_dimension=0,
-                                             tiled=True)  # (nb,)
-                f = f - f0_block * loops[None, :]
+                    bad.astype(f.dtype), edge_src, num_segments=n_nodes)
+                corr = jax.lax.psum_scatter(loc, axis, scatter_dimension=0,
+                                            tiled=True)  # (nb,)
+                f = f - f0_block * corr[None, :]
             if length in lengths:
                 out = out + f * tmask_block[None, :]
         return out
@@ -194,7 +209,7 @@ def make_ring_varexpand(mesh: Mesh, n_nodes: int, lengths: tuple,
 
 
 def ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok, tmask,
-                             lengths: tuple):
+                             lengths: tuple, correction: str = "loops"):
     """Single-device jnp twin for differential tests."""
     n_nodes = f0.shape[1]
     out = jnp.zeros_like(f0)
@@ -206,10 +221,13 @@ def ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok, tmask,
         f = jax.ops.segment_sum(per_edge.T, edge_dst,
                                 num_segments=n_nodes).T
         if length == 2:
-            is_loop = edge_ok & (edge_src == edge_dst)
-            loops = jax.ops.segment_sum(is_loop.astype(f.dtype), edge_dst,
-                                        num_segments=n_nodes)
-            f = f - f0 * loops[None, :]
+            if correction == "loops":
+                bad = edge_ok & (edge_src == edge_dst)
+            else:
+                bad = edge_ok
+            corr = jax.ops.segment_sum(bad.astype(f.dtype), edge_src,
+                                       num_segments=n_nodes)
+            f = f - f0 * corr[None, :]
         if length in lengths:
             out = out + f * tmask[None, :]
     return out
@@ -217,9 +235,9 @@ def ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok, tmask,
 
 @functools.lru_cache(maxsize=128)
 def ring_varexpand_cached(mesh: Mesh, n_nodes: int, lengths: tuple,
-                          axis: str = "shard"):
+                          axis: str = "shard", correction: str = "loops"):
     """Memoized make_ring_varexpand (compiled program reuse per shape)."""
-    return make_ring_varexpand(mesh, n_nodes, lengths, axis)
+    return make_ring_varexpand(mesh, n_nodes, lengths, axis, correction)
 
 
 @functools.lru_cache(maxsize=128)

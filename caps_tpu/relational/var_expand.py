@@ -125,9 +125,11 @@ class VarExpandOp(RelationalOperator):
 
     def _try_ring(self):
         """Ring-scheduled var-expand (multiplicity form): returns the
-        (header, table) result, or None when the shape is ineligible."""
-        if (self.rel_needed or self.into
-                or self.direction == Direction.BOTH or self.upper > 2):
+        (header, table) result, or None when the shape is ineligible.
+        All three directions qualify — undirected patterns symmetrize
+        the edge list and use the degree-form isomorphism correction
+        (parallel/ring.py make_ring_varexpand)."""
+        if self.rel_needed or self.into or self.upper > 2:
             return None
         backend = getattr(self.context.factory, "backend", None)
         if (backend is None or backend.mesh is None
@@ -196,19 +198,31 @@ class VarExpandOp(RelationalOperator):
         f0[np.arange(n_seeds), seeds] = 1
         tmask = np.zeros(n_pad, dtype=np.int64)
         tmask[nids[nok]] = 1
-        e_pad = max((((esrc.shape[0] + n_shards - 1) // n_shards)
+        if self.direction == Direction.BOTH:
+            # symmetrize: each non-loop edge in both orientations,
+            # self-loops once (VarExpandOp's BOTH hop table does the
+            # same); isomorphism correction switches to degree form
+            nonloop = eok & (esrc != etgt)
+            a = np.concatenate([esrc, etgt[nonloop]])
+            b = np.concatenate([etgt, esrc[nonloop]])
+            ok_cat = np.concatenate([eok, np.ones(nonloop.sum(), bool)])
+            correction = "degree"
+        else:
+            a, b = (esrc, etgt) if self.direction == Direction.OUTGOING \
+                else (etgt, esrc)
+            ok_cat = eok
+            correction = "loops"
+        e_pad = max((((a.shape[0] + n_shards - 1) // n_shards)
                      * n_shards), n_shards)
         frm = np.zeros(e_pad, dtype=np.int32)
         to = np.zeros(e_pad, dtype=np.int32)
         okp = np.zeros(e_pad, dtype=bool)
-        a, b = (esrc, etgt) if self.direction == Direction.OUTGOING \
-            else (etgt, esrc)
-        frm[:a.shape[0]] = np.where(eok, a, 0)
-        to[:b.shape[0]] = np.where(eok, b, 0)
-        okp[:eok.shape[0]] = eok
+        frm[:a.shape[0]] = np.where(ok_cat, a, 0)
+        to[:b.shape[0]] = np.where(ok_cat, b, 0)
+        okp[:ok_cat.shape[0]] = ok_cat
 
         fn = ring_varexpand_cached(backend.mesh, n_pad, lengths,
-                                   backend.axis)
+                                   backend.axis, correction)
         m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
                jnp.asarray(okp), jnp.asarray(tmask))
         counts = m.reshape(-1)

@@ -165,13 +165,12 @@ def test_ic13_vs_numpy(graphs):
     def bfs_len(src, dst, bound=3):
         if src == dst:
             return None  # *1..3 never matches a zero-length path…
-        frontier, seen, depth = {src}, {src}, 0
+        frontier, depth = {src}, 0
         while frontier and depth < bound:
             depth += 1
             frontier = {w for v in frontier for w in adj[v]}
             if dst in frontier:
                 return depth
-            seen |= frontier
         return None
 
     rng = np.random.RandomState(23)

@@ -173,9 +173,13 @@ def test_varexpand_rides_ring_on_mesh():
         # rel var returned -> per-path data -> join path
         ("MATCH (a)-[r:KNOWS*1..2]->(b) RETURN a.name AS a, size(r) AS n",
          "join"),
-        # undirected / upper > 2 -> join path
+        # undirected rides the ring too (symmetrized edges + degree
+        # correction)
         ("MATCH (a)-[:KNOWS*1..2]-(b) RETURN a.name AS a, b.name AS b",
-         "join"),
+         "ring-matrix"),
+        ("MATCH (a)-[*0..2]-(b:Person) RETURN b.name AS b",
+         "ring-matrix"),
+        # upper > 2 -> join path
         ("MATCH (a)-[:KNOWS*1..3]->(b) RETURN a.name AS a, b.name AS b",
          "join"),
     ]
@@ -187,3 +191,53 @@ def test_varexpand_rides_ring_on_mesh():
         ve = [m for m in res.metrics["operators"] if m["op"] == "VarExpand"]
         assert ve and ve[0]["strategy"] == want_strategy, (q, ve)
     assert sharded.fallback_count == 0, sharded.backend.fallback_reasons
+
+
+def test_ring_varexpand_undirected_oracle(mesh):
+    """Degree-form correction vs brute-force undirected path
+    enumeration with relationship isomorphism (e2 != e1), including
+    self-loops and parallel edges."""
+    from caps_tpu.parallel.ring import (
+        make_ring_varexpand, ring_varexpand_reference,
+    )
+
+    n_nodes, n_edges = 16, 40
+    rng = np.random.RandomState(9)
+    src = rng.randint(0, n_nodes, n_edges).astype(np.int32)
+    dst = rng.randint(0, n_nodes, n_edges).astype(np.int32)
+    src[:5] = dst[:5]               # self-loops
+    src[5:8], dst[5:8] = src[8:11], dst[8:11]  # parallel edges
+
+    # symmetrize exactly as the engine does
+    nonloop = src != dst
+    a = np.concatenate([src, dst[nonloop]])
+    b = np.concatenate([dst, src[nonloop]])
+    pad = (-len(a)) % 8
+    a = np.concatenate([a, np.zeros(pad, np.int32)])
+    b = np.concatenate([b, np.zeros(pad, np.int32)])
+    okp = np.concatenate([np.ones(len(a) - pad, bool), np.zeros(pad, bool)])
+
+    f0 = np.eye(n_nodes, dtype=np.int64)
+    tmask = np.ones(n_nodes, dtype=np.int64)
+    fn = make_ring_varexpand(mesh, n_nodes, (1, 2), correction="degree")
+    got = np.asarray(fn(jnp.asarray(f0), jnp.asarray(a), jnp.asarray(b),
+                        jnp.asarray(okp), jnp.asarray(tmask)))
+    ref = np.asarray(ring_varexpand_reference(
+        jnp.asarray(f0), jnp.asarray(a), jnp.asarray(b), jnp.asarray(okp),
+        jnp.asarray(tmask), (1, 2), correction="degree"))
+    np.testing.assert_array_equal(got, ref)
+
+    # brute force: undirected steps carry (edge id, far end)
+    steps = [[] for _ in range(n_nodes)]  # node -> [(eid, far)]
+    for eid, (u, v) in enumerate(zip(src, dst)):
+        steps[u].append((eid, v))
+        if u != v:
+            steps[v].append((eid, u))
+    want = np.zeros((n_nodes, n_nodes), dtype=np.int64)
+    for s0 in range(n_nodes):
+        for e1, m in steps[s0]:
+            want[s0, m] += 1                        # length 1
+            for e2, t in steps[m]:
+                if e2 != e1:
+                    want[s0, t] += 1                # length 2
+    np.testing.assert_array_equal(got, want)
