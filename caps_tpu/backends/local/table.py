@@ -9,6 +9,7 @@ against this one.
 """
 from __future__ import annotations
 
+import math
 import statistics
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -277,9 +278,11 @@ class LocalTable(Table):
                 return None
             svals = sorted(vals)
             p = a.percentile or 0.0
-            pos = p * (len(svals) - 1)
             if a.kind == "percentile_disc":
-                return svals[min(len(svals) - 1, int(round(pos)))]
+                # nearest-rank (Neo4j semantics): 1-based rank ceil(p*n)
+                rank = max(1, math.ceil(p * len(svals)))
+                return svals[min(len(svals), rank) - 1]
+            pos = p * (len(svals) - 1)
             lo, hi = int(pos), min(int(pos) + 1, len(svals) - 1)
             frac = pos - int(pos)
             return svals[lo] * (1 - frac) + svals[hi] * frac

@@ -534,8 +534,9 @@ class DeviceTable(Table):
     def _group_device(self, by: Sequence[str],
                       aggs: Sequence[AggSpec]) -> "DeviceTable":
         for a in aggs:
-            if a.kind in ("percentile_cont", "percentile_disc"):
-                raise UnsupportedOnDevice(f"{a.kind} aggregation")
+            if a.kind in ("percentile_cont", "percentile_disc") \
+                    and a.distinct:
+                raise UnsupportedOnDevice(f"{a.kind} DISTINCT aggregation")
         fast = self._group_dense_pallas(by, aggs)
         if fast is not None:
             return fast
@@ -592,11 +593,63 @@ class DeviceTable(Table):
             return firstocc_cache[col_name]
 
         for a in aggs:
+            if a.kind in ("percentile_cont", "percentile_disc"):
+                out[a.name] = self._percentile_agg(
+                    a, sorted_cols, group_keys_sorted, seg_id, num_segments,
+                    row_ok_sorted, n_groups, start_idx)
+                continue
             extra = firstocc_for(a.col) if a.distinct else None
             out[a.name] = self._one_agg(a, sorted_cols, seg_id, num_segments,
                                         row_ok_sorted, n_groups,
                                         firstocc=extra, start_idx=start_idx)
         return DeviceTable(self.backend, out, n_groups)
+
+    def _percentile_agg(self, a: AggSpec, cols: Dict[str, Column],
+                        group_keys_sorted, seg_id, num_segments: int,
+                        row_ok, n_groups: int, start_idx) -> Column:
+        """percentileDisc/percentileCont on device: one extra stable sort
+        by (group keys, value) puts each group's valid values ascending at
+        the head of its row block, so the percentile is a rank gather —
+        disc picks the ceil(p·n) nearest rank (Neo4j semantics, matching
+        the oracle), cont lerps between the straddling ranks.  The re-sort
+        is group-major with the same keys, so each group's block keeps the
+        caller's offsets (``start_idx``)."""
+        group_live = jnp.arange(num_segments) < n_groups
+        col = cols[a.col]
+        if col.kind not in ("int", "float", "id", "bool"):
+            raise UnsupportedOnDevice(f"{a.kind} over kind {col.kind}")
+        pool = self.backend.pool
+        vk = _sort_keys(col, True, True, pool)
+        # grouped: group_keys_sorted[0] is already the ~row_ok key;
+        # ungrouped it must be added — capacity-padding rows LOOK valid
+        # (compaction duplicates row 0) and would interleave the run
+        lead = (list(group_keys_sorted) if group_keys_sorted
+                else [(~row_ok).astype(jnp.int64)])
+        p2 = self._sort_perm(lead + vk)
+        ok = (col.valid & row_ok)[p2]
+        seg2 = seg_id[p2]  # still non-decreasing: stable + group-major
+        values = col.data[p2]
+        counts = K.sorted_segment_agg(ok, ok, seg2, num_segments, "count")
+        starts = start_idx.astype(jnp.int64)
+        p = float(a.percentile or 0.0)
+        cap_idx = values.shape[0] - 1
+        if a.kind == "percentile_disc":
+            # nearest-rank (Neo4j semantics): 1-based rank ceil(p*n)
+            rank = jnp.ceil(p * counts.astype(jnp.float64)).astype(jnp.int64)
+            r = jnp.clip(jnp.maximum(rank, 1) - 1, 0,
+                         jnp.maximum(counts - 1, 0))
+            data = values[jnp.clip(starts + r, 0, cap_idx)]
+            return Column(col.kind, data, (counts > 0) & group_live,
+                          col.ctype)
+        pos = p * jnp.maximum(counts - 1, 0).astype(jnp.float64)
+        lo = jnp.floor(pos).astype(jnp.int64)
+        hi = jnp.minimum(lo + 1, jnp.maximum(counts - 1, 0))
+        frac = pos - lo.astype(jnp.float64)
+        vlo = values[jnp.clip(starts + lo, 0, cap_idx)].astype(jnp.float64)
+        vhi = values[jnp.clip(starts + hi, 0, cap_idx)].astype(jnp.float64)
+        data = vlo * (1.0 - frac) + vhi * frac
+        from caps_tpu.okapi.types import CTFloat
+        return Column("float", data, (counts > 0) & group_live, CTFloat)
 
     def _group_dense_pallas(self, by: Sequence[str],
                             aggs: Sequence[AggSpec]

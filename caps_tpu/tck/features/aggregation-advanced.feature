@@ -201,3 +201,64 @@ Feature: Aggregation edge cases
     Then the result should be, in any order:
       | mean |
       | 2    |
+
+  Scenario: percentileDisc uses the nearest-rank method
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 10}), ({v: 20}), ({v: 30}), ({v: 40})
+      """
+    When executing query:
+      """
+      MATCH (n)
+      RETURN percentileDisc(n.v, 0.0) AS p0, percentileDisc(n.v, 0.5) AS p50,
+             percentileDisc(n.v, 0.51) AS p51, percentileDisc(n.v, 1.0) AS p100
+      """
+    Then the result should be, in any order:
+      | p0 | p50 | p51 | p100 |
+      | 10 | 20  | 30  | 40   |
+
+  Scenario: percentileCont interpolates linearly
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: 10}), ({v: 20}), ({v: 40})
+      """
+    When executing query:
+      """
+      MATCH (n)
+      RETURN percentileCont(n.v, 0.5) AS med, percentileCont(n.v, 0.75) AS q3
+      """
+    Then the result should be, in any order:
+      | med  | q3   |
+      | 20.0 | 30.0 |
+
+  Scenario: percentile of no rows is null and skips nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({w: 1}), ({v: 5}), ({v: 7})
+      """
+    When executing query:
+      """
+      MATCH (n)
+      RETURN percentileDisc(n.v, 0.5) AS d, percentileCont(n.w, 0.5) AS c
+      """
+    Then the result should be, in any order:
+      | d | c   |
+      | 5 | 1.0 |
+
+  Scenario: grouped percentile over string groups
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({g: 'a', v: 1}), ({g: 'a', v: 3}), ({g: 'b', v: 9})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN n.g AS g, percentileDisc(n.v, 1.0) AS mx
+      """
+    Then the result should be, in any order:
+      | g   | mx |
+      | 'a' | 3  |
+      | 'b' | 9  |

@@ -66,3 +66,45 @@ def test_with_aggregation_pipeline(init_graph, run, bag):
     rows = run(g, "MATCH (n) WITH n.g AS g, count(*) AS c WHERE c > 1 "
                   "RETURN g, c")
     assert rows == [{"g": "a", "c": 2}]
+
+
+def test_percentile_disc_and_cont(init_graph, run, bag):
+    g = init_graph("CREATE ({v: 10}), ({v: 20}), ({v: 30}), ({v: 40}), "
+                   "({w: 1})")
+    rows = run(g, "MATCH (n) RETURN percentileDisc(n.v, 0.5) AS d, "
+                  "percentileCont(n.v, 0.5) AS c")
+    assert rows == [{"d": 20, "c": 25.0}]
+    rows = run(g, "MATCH (n) RETURN percentileDisc(n.v, 0.0) AS lo, "
+                  "percentileDisc(n.v, 1.0) AS hi, "
+                  "percentileCont(n.v, 0.25) AS q1")
+    assert rows == [{"lo": 10, "hi": 40, "q1": 17.5}]
+
+
+def test_percentile_grouped(init_graph, run, bag):
+    g = init_graph("CREATE ({g: 'a', v: 1}), ({g: 'a', v: 3}), "
+                   "({g: 'a', v: 5}), ({g: 'b', v: 7}), ({g: 'c', w: 0})")
+    rows = run(g, "MATCH (n) RETURN n.g AS g, "
+                  "percentileDisc(n.v, 0.5) AS d, "
+                  "percentileCont(n.v, 0.5) AS c")
+    assert bag(rows) == [{"g": "a", "d": 3, "c": 3.0},
+                         {"g": "b", "d": 7, "c": 7.0},
+                         {"g": "c", "d": None, "c": None}]
+
+
+def test_percentile_float_values(init_graph, run):
+    g = init_graph("CREATE ({v: 1.5}), ({v: 2.5}), ({v: 4.0})")
+    rows = run(g, "MATCH (n) RETURN percentileCont(n.v, 0.5) AS c, "
+                  "percentileDisc(n.v, 0.75) AS d")
+    assert rows == [{"c": 2.5, "d": 4.0}]
+
+
+def test_percentile_after_filter(init_graph, run):
+    # regression: ungrouped percentile over a COMPACTED table — capacity
+    # padding duplicates row values and must not enter the value run
+    g = init_graph("CREATE ({v: 5}), ({v: 9}), ({v: 2}), ({v: 100}), "
+                   "({v: 101}), ({v: 102})")
+    rows = run(g, "MATCH (n) WHERE n.v < 50 "
+                  "RETURN percentileDisc(n.v, 1.0) AS mx, "
+                  "percentileCont(n.v, 1.0) AS cmx, "
+                  "percentileDisc(n.v, 0.5) AS med")
+    assert rows == [{"mx": 9, "cmx": 9.0, "med": 5}]
