@@ -240,6 +240,20 @@ def ring_varexpand_cached(mesh: Mesh, n_nodes: int, lengths: tuple,
     return make_ring_varexpand(mesh, n_nodes, lengths, axis, correction)
 
 
+@functools.lru_cache(maxsize=32)
+def ring_varexpand_single(lengths: tuple, correction: str = "loops"):
+    """Single-device matrix var-expand: the same SpMV-hop computation as
+    the ring body, without collectives, as one jitted program (the
+    VarExpand matrix strategy off-mesh).  One wrapper per (lengths,
+    correction) — jax's own trace cache handles the shapes."""
+    @jax.jit
+    def fn(f0, edge_src, edge_dst, edge_ok, tmask):
+        return ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok,
+                                        tmask, lengths, correction)
+
+    return fn
+
+
 @functools.lru_cache(maxsize=128)
 def ring_khop_cached(mesh: Mesh, n_nodes: int, n_hops: int,
                      axis: str = "shard", masked: bool = False):

@@ -12,13 +12,14 @@ ids packed into one list-valued column.  Static unrolling is deliberate —
 on the TPU backend every hop is a fixed-shape join the compiler can fuse,
 the device-side analog of ragged frontier schedules (SURVEY.md §5.7).
 
-On a device mesh, when the relationship variable is dead downstream (the
-planner proves it — no projection, filter, or return touches it), the op
-instead rides the ppermute RING schedule (parallel/ring.py,
-``make_ring_varexpand``): a per-seed path-count matrix rotates node blocks
-around the ICI against resident adjacency shards, and the (source, target,
-multiplicity) result is exploded back into rows — the general-frontier
-form of SURVEY.md §5.7's "frontier = long sequence" story.  Per-path
+When the relationship variable is dead downstream (the planner proves it
+— no projection, filter, or return touches it), the op instead computes a
+per-seed path-count MATRIX and explodes (source, target, multiplicity)
+back into rows — the general-frontier form of SURVEY.md §5.7's "frontier
+= long sequence" story.  On a device mesh the matrix rides the ppermute
+RING schedule against resident adjacency shards (parallel/ring.py,
+``make_ring_varexpand``, strategy "ring-matrix"); single-chip the same
+SpMV hops run as one jitted program (strategy "matrix").  Per-path
 relationship lists cannot ride this form; those queries stay on joins.
 """
 from __future__ import annotations
@@ -106,7 +107,7 @@ class VarExpandOp(RelationalOperator):
         self._metric_extra = {"strategy": self.strategy}
         return out
 
-    # -- ring-matrix path (mesh only; see module docstring) ----------------
+    # -- matrix path (ring on mesh, SpMV single-chip; see module docstring)
 
     # Refuse seed-matrix shapes beyond this many entries (int64 frontier
     # blocks must fit comfortably in HBM across the mesh); larger inputs
@@ -124,23 +125,27 @@ class VarExpandOp(RelationalOperator):
         return table.host_column(col)
 
     def _try_ring(self):
-        """Ring-scheduled var-expand (multiplicity form): returns the
+        """Matrix-form var-expand (multiplicity form): returns the
         (header, table) result, or None when the shape is ineligible.
         All three directions qualify — undirected patterns symmetrize
-        the edge list and use the degree-form isomorphism correction
-        (parallel/ring.py make_ring_varexpand)."""
+        the edge list and use the degree-form isomorphism correction.
+        On a mesh the per-seed count matrix rides the ppermute ring
+        (parallel/ring.py make_ring_varexpand); single-chip it runs the
+        same SpMV hops as one jitted program (the twin) — either way the
+        join cascade and its per-hop materializations disappear."""
         if self.rel_needed or self.into or self.upper > 2:
             return None
         backend = getattr(self.context.factory, "backend", None)
-        if (backend is None or backend.mesh is None
-                or not backend.config.use_ring):
+        if backend is None or not backend.config.use_ring:
             return None
         import jax.numpy as jnp
         from caps_tpu.backends.tpu import kernels as K
         from caps_tpu.backends.tpu.column import Column
         from caps_tpu.backends.tpu.table import DeviceTable
         from caps_tpu.okapi.types import CTInteger
-        from caps_tpu.parallel.ring import ring_varexpand_cached
+        from caps_tpu.parallel.ring import (
+            ring_varexpand_cached, ring_varexpand_single,
+        )
 
         parent_header, parent_table = self.children[0].result
         src_id_col = parent_header.column(E.Var(self.source))
@@ -179,7 +184,8 @@ class VarExpandOp(RelationalOperator):
         if n_seeds * n_pad > self._RING_MAX_MATRIX:
             return None
         lengths = tuple(range(self.lower, self.upper + 1))
-        self.strategy = "ring-matrix"
+        self.strategy = "ring-matrix" if backend.mesh is not None \
+            else "matrix"
         rel_list_type = CTList(CTRelationship(self.rel_types))
 
         if n_seeds == 0:
@@ -221,8 +227,11 @@ class VarExpandOp(RelationalOperator):
         to[:b.shape[0]] = np.where(ok_cat, b, 0)
         okp[:ok_cat.shape[0]] = ok_cat
 
-        fn = ring_varexpand_cached(backend.mesh, n_pad, lengths,
-                                   backend.axis, correction)
+        if backend.mesh is not None:
+            fn = ring_varexpand_cached(backend.mesh, n_pad, lengths,
+                                       backend.axis, correction)
+        else:
+            fn = ring_varexpand_single(lengths, correction)
         m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
                jnp.asarray(okp), jnp.asarray(tmask))
         counts = m.reshape(-1)

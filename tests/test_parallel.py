@@ -241,3 +241,34 @@ def test_ring_varexpand_undirected_oracle(mesh):
                 if e2 != e1:
                     want[s0, t] += 1                # length 2
     np.testing.assert_array_equal(got, want)
+
+
+def test_varexpand_matrix_single_chip():
+    """Off-mesh, an eligible var-expand takes the single-device matrix
+    strategy (same SpMV computation, no collectives) with oracle
+    parity."""
+    from caps_tpu.backends.local.session import LocalCypherSession
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.testing.bag import Bag
+    from caps_tpu.testing.factory import create_graph
+
+    create = ("CREATE (a:Person {name:'Alice'}), (b:Person {name:'Bob'}), "
+              "(c:Person {name:'Carol'}), (a)-[:KNOWS]->(b), "
+              "(b)-[:KNOWS]->(c), (c)-[:KNOWS]->(c)")
+    tpu = TPUCypherSession()
+    oracle = LocalCypherSession()
+    gt = create_graph(tpu, create, {})
+    go = create_graph(oracle, create, {})
+    for q, strat in [
+        ("MATCH (a)-[:KNOWS*1..2]->(b) RETURN a.name AS a, b.name AS b",
+         "matrix"),
+        ("MATCH (a)-[:KNOWS*1..2]-(b) RETURN a.name AS a, b.name AS b",
+         "matrix"),
+        ("MATCH (a)-[r:KNOWS*1..2]->(b) RETURN size(r) AS n", "join"),
+    ]:
+        res = gt.cypher(q)
+        assert Bag(res.records.to_maps()) == \
+            Bag(go.cypher(q).records.to_maps()), q
+        ve = [m for m in res.metrics["operators"] if m["op"] == "VarExpand"]
+        assert ve and ve[0]["strategy"] == strat, (q, ve)
+    assert tpu.fallback_count == 0
