@@ -50,13 +50,16 @@ _result = {
     "vs_baseline": 0.0,
 }
 _printed = False
+_emit_lock = __import__("threading").Lock()
 
 
 def _emit():
     global _printed
-    if not _printed:
+    with _emit_lock:  # watchdog thread and main thread may race here
+        if _printed:
+            return
         _printed = True
-        print(json.dumps(_result), flush=True)
+    print(json.dumps(_result), flush=True)
 
 
 def _remaining() -> float:
@@ -79,6 +82,23 @@ def _install_guards():
         signal.signal(signal.SIGTERM, _on_alarm)
     except (ValueError, AttributeError):
         pass  # non-main thread / platform without signals
+    # Last-resort watchdog: SIGALRM only fires between bytecodes, so a
+    # main thread blocked inside a wedged device call (observed: a dead
+    # TPU tunnel hangs block_until_ready indefinitely) would never emit.
+    # A daemon thread still runs then (device waits release the GIL) and
+    # force-prints the best-so-far result before killing the process.
+    import threading
+
+    def _watchdog():
+        import time as _t
+        _t.sleep(DEADLINE_S + 20)
+        # cannot distinguish a wedged device call from a merely-slow run
+        # from here — label it as the deadline it is
+        _result["metric"] += " [watchdog deadline; partial]"
+        _emit()
+        os._exit(0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
 
 
 def _probe_device(timeout_s: float | None = None) -> bool:

@@ -64,8 +64,11 @@ class EngineConfig:
     # pattern chains to SpMV over the adjacency instead of join+count.
     use_count_pushdown: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_COUNT_PUSHDOWN", True))
-    # On a mesh, uniform pushdown chains use the ppermute ring schedule
-    # (parallel/ring.py) instead of XLA-inserted all-reduces.
+    # Matrix/ring expansion strategies (parallel/ring.py): on a mesh,
+    # uniform pushdown chains and eligible var-expands ride the ppermute
+    # ring schedule instead of XLA-inserted all-reduces; single-chip,
+    # the same eligible var-expands run as one SpMV matrix program
+    # (VarExpandOp strategy "matrix") instead of the join cascade.
     use_ring: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_RING", True))
     # Fused executor (backends/tpu/fused.py): record data-dependent sizes

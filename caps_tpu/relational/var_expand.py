@@ -220,6 +220,10 @@ class VarExpandOp(RelationalOperator):
             correction = "loops"
         e_pad = max((((a.shape[0] + n_shards - 1) // n_shards)
                      * n_shards), n_shards)
+        # peak working set is the per-hop (seeds, edges) gather — bound
+        # it like the (seeds, nodes) frontier (per shard on a mesh)
+        if n_seeds * (e_pad // n_shards) > self._RING_MAX_MATRIX:
+            return None
         frm = np.zeros(e_pad, dtype=np.int32)
         to = np.zeros(e_pad, dtype=np.int32)
         okp = np.zeros(e_pad, dtype=bool)
