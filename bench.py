@@ -36,6 +36,7 @@ import signal
 import statistics
 import subprocess
 import sys
+import threading
 import time
 
 _T0 = time.time()
@@ -50,16 +51,19 @@ _result = {
     "vs_baseline": 0.0,
 }
 _printed = False
-_emit_lock = __import__("threading").Lock()
+_emit_lock = threading.Lock()
 
 
 def _emit():
     global _printed
-    with _emit_lock:  # watchdog thread and main thread may race here
+    # the whole check-mutate-print must hold the lock: the watchdog
+    # mutates _result["metric"] before calling here, and a snapshot
+    # printed outside the lock could carry its label onto a completed run
+    with _emit_lock:
         if _printed:
             return
         _printed = True
-    print(json.dumps(_result), flush=True)
+        print(json.dumps(_result), flush=True)
 
 
 def _remaining() -> float:
@@ -87,14 +91,14 @@ def _install_guards():
     # TPU tunnel hangs block_until_ready indefinitely) would never emit.
     # A daemon thread still runs then (device waits release the GIL) and
     # force-prints the best-so-far result before killing the process.
-    import threading
-
     def _watchdog():
-        import time as _t
-        _t.sleep(DEADLINE_S + 20)
-        # cannot distinguish a wedged device call from a merely-slow run
-        # from here — label it as the deadline it is
-        _result["metric"] += " [watchdog deadline; partial]"
+        time.sleep(DEADLINE_S + 20)
+        with _emit_lock:
+            if _printed:  # completed run already emitted; just exit
+                os._exit(0)
+            # cannot distinguish a wedged device call from a merely-slow
+            # run from here — label it as the deadline it is
+            _result["metric"] += " [watchdog deadline; partial]"
         _emit()
         os._exit(0)
 

@@ -93,7 +93,13 @@ class DeviceBackend:
         self.fused_count_fns: Dict[tuple, tuple] = {}
         self.mesh = None
         self.axis = config.mesh_axis
-        if config.mesh_shape:
+        if len(config.mesh_shape) >= 2:
+            # multi-slice: ("dcn", axis) with DCN outer (SURVEY.md §5.8)
+            from caps_tpu.parallel.mesh import make_mesh_2d
+            self.mesh = make_mesh_2d(
+                (math.prod(config.mesh_shape[:-1]), config.mesh_shape[-1]),
+                axis=self.axis)
+        elif config.mesh_shape:
             from caps_tpu.parallel.mesh import make_mesh
             self.mesh = make_mesh(math.prod(config.mesh_shape),
                                   axis=self.axis)
@@ -109,7 +115,9 @@ class DeviceBackend:
                 or arr.shape[0] % self.n_shards):
             return arr
         from jax.sharding import NamedSharding, PartitionSpec as P
-        spec = (self.axis,) + (None,) * (arr.ndim - 1)
+        # rows flatten over every mesh axis (1-D: (axis,); 2-D: DCN-major
+        # so each slice owns a contiguous row range)
+        spec = (tuple(self.mesh.axis_names),) + (None,) * (arr.ndim - 1)
         return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
 
     def place_column(self, col: Column) -> Column:

@@ -159,35 +159,41 @@ def dense_segment_agg(codes: jnp.ndarray, ok: jnp.ndarray,
 
 
 @functools.lru_cache(maxsize=256)
-def _sharded_agg_fn(mesh, axis: str, num_segments: int, kind: str,
-                    interpret: bool):
+def _sharded_agg_fn(mesh, num_segments: int, kind: str, interpret: bool):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    # rows split over EVERY mesh axis (matches DeviceBackend.place_rows):
+    # on a 2-D DCN x ICI mesh each device keeps its own row block and only
+    # the final (num_segments,) partials cross DCN in the combine
+    axes = tuple(mesh.axis_names)
 
     def body(c, o, v):
         local = dense_segment_agg(c, o, v, num_segments, kind,
                                   interpret=interpret)
         if kind.startswith("min"):
-            return jax.lax.pmin(local, axis)
+            return jax.lax.pmin(local, axes)
         if kind.startswith("max"):
-            return jax.lax.pmax(local, axis)
-        return jax.lax.psum(local, axis)
+            return jax.lax.pmax(local, axes)
+        return jax.lax.psum(local, axes)
 
     # check_vma=False: pallas_call outputs don't carry varying-mesh-axis
     # metadata, so shard_map's vma checker can't see through them.
     return jax.jit(shard_map(body, mesh=mesh,
-                             in_specs=(P(axis), P(axis), P(axis)),
+                             in_specs=(P(axes), P(axes), P(axes)),
                              out_specs=P(), check_vma=False))
 
 
 def dense_segment_agg_sharded(mesh, axis: str, codes, ok, values,
                               num_segments: int, kind: str,
                               interpret: bool = False) -> jnp.ndarray:
-    """Distributed histogram: each shard aggregates its row block with the
-    Pallas kernel, partials combine over ICI (psum / pmin / pmax) — the
+    """Distributed histogram: each device aggregates its row block with
+    the Pallas kernel, partials combine over the mesh (psum / pmin /
+    pmax; ICI within a slice, DCN only for the final partials) — the
     engine's partial-aggregation shuffle (SURVEY.md §5.8).  The jitted
-    shard_map program is cached per (mesh, axis, segments, kind)."""
-    fn = _sharded_agg_fn(mesh, axis, num_segments, kind, interpret)
+    shard_map program is cached per (mesh, segments, kind)."""
+    del axis  # rows always split over every mesh axis (place_rows layout)
+    fn = _sharded_agg_fn(mesh, num_segments, kind, interpret)
     return fn(codes.astype(jnp.int32), ok,
               values if kind != "count" else codes.astype(jnp.int32))
 

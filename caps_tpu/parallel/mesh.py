@@ -14,13 +14,30 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
+def _take_devices(n: int):
     devices = jax.devices()
-    if n_devices is not None:
-        if len(devices) < n_devices:
-            raise ValueError(
-                f"need {n_devices} devices, have {len(devices)} "
-                "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
-                "with JAX_PLATFORMS=cpu for virtual meshes)")
-        devices = devices[:n_devices]
+    if len(devices) < n:
+        raise ValueError(
+            f"need {n} devices, have {len(devices)} "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+            "with JAX_PLATFORMS=cpu for virtual meshes)")
+    return devices[:n]
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
+    devices = (jax.devices() if n_devices is None
+               else _take_devices(n_devices))
     return Mesh(np.array(devices), (axis,))
+
+
+def make_mesh_2d(shape: Sequence[int], axis: str = "shard") -> Mesh:
+    """Two-level mesh for multi-slice topologies: ("dcn", axis) with the
+    slow axis OUTER — row sharding flattens over (dcn, axis) so
+    consecutive blocks live on one slice, XLA keeps bulk collectives on
+    ICI within a slice and crosses DCN only for the final combines
+    (SURVEY.md §5.8: ICI within a slice, DCN across slices).  The
+    hand-scheduled ppermute rings are a 1-D-mesh optimization; on 2-D
+    meshes the engine uses the GSPMD partitioner paths."""
+    n_dcn, n_ici = int(shape[0]), int(shape[1])
+    arr = np.array(_take_devices(n_dcn * n_ici)).reshape(n_dcn, n_ici)
+    return Mesh(arr, ("dcn", axis))

@@ -1007,6 +1007,10 @@ class CountPatternOp(RelationalOperator):
         backend = getattr(self.context.factory, "backend", None)
         if mesh is None or backend is None:
             return None
+        if mesh.devices.ndim != 1:
+            # the hand-scheduled ring is a 1-D-mesh optimization; 2-D
+            # (DCN x ICI) meshes take the GSPMD spmv-sharded path
+            return None
         if not getattr(backend.config, "use_ring", True):
             return None
         if len(self.lengths) != 1 or self.lengths[0] < 1:

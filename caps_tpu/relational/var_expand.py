@@ -184,8 +184,10 @@ class VarExpandOp(RelationalOperator):
         if n_seeds * n_pad > self._RING_MAX_MATRIX:
             return None
         lengths = tuple(range(self.lower, self.upper + 1))
-        self.strategy = "ring-matrix" if backend.mesh is not None \
-            else "matrix"
+        self.strategy = ("ring-matrix"
+                         if backend.mesh is not None
+                         and backend.mesh.devices.ndim == 1
+                         else "matrix")
         rel_list_type = CTList(CTRelationship(self.rel_types))
 
         if n_seeds == 0:
@@ -221,8 +223,13 @@ class VarExpandOp(RelationalOperator):
         e_pad = max((((a.shape[0] + n_shards - 1) // n_shards)
                      * n_shards), n_shards)
         # peak working set is the per-hop (seeds, edges) gather — bound
-        # it like the (seeds, nodes) frontier (per shard on a mesh)
-        if n_seeds * (e_pad // n_shards) > self._RING_MAX_MATRIX:
+        # it like the (seeds, nodes) frontier.  Only the 1-D ring path
+        # splits edges across devices; single-chip and 2-D meshes run
+        # the whole gather on one device's program.
+        on_ring = (backend.mesh is not None
+                   and backend.mesh.devices.ndim == 1)
+        edges_per_device = e_pad // n_shards if on_ring else e_pad
+        if n_seeds * edges_per_device > self._RING_MAX_MATRIX:
             return None
         frm = np.zeros(e_pad, dtype=np.int32)
         to = np.zeros(e_pad, dtype=np.int32)
@@ -231,10 +238,12 @@ class VarExpandOp(RelationalOperator):
         to[:b.shape[0]] = np.where(ok_cat, b, 0)
         okp[:ok_cat.shape[0]] = ok_cat
 
-        if backend.mesh is not None:
+        if on_ring:
             fn = ring_varexpand_cached(backend.mesh, n_pad, lengths,
                                        backend.axis, correction)
         else:
+            # single chip, or a 2-D (DCN x ICI) mesh where the GSPMD
+            # partitioner schedules the collectives
             fn = ring_varexpand_single(lengths, correction)
         m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
                jnp.asarray(okp), jnp.asarray(tmask))
