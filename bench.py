@@ -92,14 +92,17 @@ def _install_guards():
     # A daemon thread still runs then (device waits release the GIL) and
     # force-prints the best-so-far result before killing the process.
     def _watchdog():
+        global _printed
         time.sleep(DEADLINE_S + 20)
+        # label-mutate and print under ONE lock hold, or a completed run
+        # emitting concurrently could pick up the partial label
         with _emit_lock:
-            if _printed:  # completed run already emitted; just exit
-                os._exit(0)
-            # cannot distinguish a wedged device call from a merely-slow
-            # run from here — label it as the deadline it is
-            _result["metric"] += " [watchdog deadline; partial]"
-        _emit()
+            if not _printed:
+                _printed = True
+                # cannot distinguish a wedged device call from a merely-
+                # slow run from here — label it as the deadline it is
+                _result["metric"] += " [watchdog deadline; partial]"
+                print(json.dumps(_result), flush=True)
         os._exit(0)
 
     threading.Thread(target=_watchdog, daemon=True).start()

@@ -405,7 +405,8 @@ _FUNCTIONS: Dict[str, Callable] = {
     "log10": _null_guard(lambda v: math.log10(v) if v > 0 else None),
     "sin": _null_guard(math.sin), "cos": _null_guard(math.cos),
     "tan": _null_guard(math.tan), "atan": _null_guard(math.atan),
-    "asin": _null_guard(math.asin), "acos": _null_guard(math.acos),
+    "asin": _null_guard(lambda v: math.asin(v) if -1 <= v <= 1 else None),
+    "acos": _null_guard(lambda v: math.acos(v) if -1 <= v <= 1 else None),
     "e": lambda: math.e, "pi": lambda: math.pi,
     "touppercase": _null_guard(lambda s: s.upper()),
     "toupper": _null_guard(lambda s: s.upper()),

@@ -423,9 +423,19 @@ class DeviceExprCompiler:
                        "tan": jnp.tan, "atan": jnp.arctan, "asin": jnp.arcsin,
                        "acos": jnp.arccos, "ceil": jnp.ceil,
                        "floor": jnp.floor}
+        # out-of-domain inputs are null in Cypher, not nan/inf — fold the
+        # domain into the validity mask (dense twin of the oracle's guards)
+        unary_domain = {"sqrt": lambda v: v >= 0, "log": lambda v: v > 0,
+                        "log10": lambda v: v > 0,
+                        "asin": lambda v: jnp.abs(v) <= 1,
+                        "acos": lambda v: jnp.abs(v) <= 1}
         if name in unary_float:
             c = args[0].astype_kind("float")
-            return Column("float", unary_float[name](c.data), c.valid, CTFloat)
+            valid = c.valid
+            if name in unary_domain:
+                valid = valid & unary_domain[name](c.data)
+            safe = jnp.where(valid, c.data, 1.0)
+            return Column("float", unary_float[name](safe), valid, CTFloat)
         if name == "round":
             c = args[0].astype_kind("float")
             return Column("float", jnp.floor(c.data + 0.5), c.valid, CTFloat)

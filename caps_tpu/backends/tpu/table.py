@@ -93,7 +93,9 @@ class DeviceBackend:
         self.fused_count_fns: Dict[tuple, tuple] = {}
         self.mesh = None
         self.axis = config.mesh_axis
-        if len(config.mesh_shape) >= 2:
+        # degenerate leading axes collapse to a 1-D mesh so (1, 8) keeps
+        # the hand-scheduled ring fast paths that (8,) gets
+        if math.prod(config.mesh_shape[:-1] or (1,)) > 1:
             # multi-slice: ("dcn", axis) with DCN outer (SURVEY.md §5.8)
             from caps_tpu.parallel.mesh import make_mesh_2d
             self.mesh = make_mesh_2d(

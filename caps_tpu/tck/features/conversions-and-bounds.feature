@@ -1,0 +1,226 @@
+Feature: Type conversions and boundary forms
+  # Written from openCypher spec semantics (not engine behavior):
+  # toInteger/toFloat/toBoolean/toString coercion tables, numeric
+  # function edge cases, empty/degenerate var-length ranges, and
+  # list-function boundaries.
+
+  Scenario: toInteger over numbers and strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toInteger(42) AS a, toInteger(3.9) AS b,
+             toInteger('17') AS c, toInteger('42.9') AS d,
+             toInteger('nope') AS e, toInteger(null) AS f
+      """
+    Then the result should be, in any order:
+      | a  | b | c  | d  | e    | f    |
+      | 42 | 3 | 17 | 42 | null | null |
+
+  Scenario: toFloat over numbers and strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toFloat(2) AS a, toFloat('3.25') AS b, toFloat('x') AS c,
+             toFloat(null) AS d
+      """
+    Then the result should be, in any order:
+      | a   | b    | c    | d    |
+      | 2.0 | 3.25 | null | null |
+
+  Scenario: toString over every primitive
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toString(7) AS a, toString(1.5) AS b, toString(true) AS c,
+             toString('s') AS d, toString(null) AS e
+      """
+    Then the result should be, in any order:
+      | a   | b     | c      | d   | e    |
+      | '7' | '1.5' | 'true' | 's' | null |
+
+  Scenario: toBoolean over strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN toBoolean('true') AS a, toBoolean('FALSE') AS b,
+             toBoolean('maybe') AS c, toBoolean(null) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b     | c    | d    |
+      | true | false | null | null |
+
+  Scenario: numeric functions at domain edges yield null, not errors
+    Given an empty graph
+    When executing query:
+      """
+      RETURN sqrt(-1.0) AS a, log(0.0) AS b, log(-2.0) AS c,
+             log10(0.0) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c    | d    |
+      | null | null | null | null |
+
+  Scenario: sign and abs over signs and zero
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [-5, 0, 3] AS v RETURN v, sign(v) AS s, abs(v) AS a
+      """
+    Then the result should be, in any order:
+      | v  | s  | a |
+      | -5 | -1 | 5 |
+      | 0  | 0  | 0 |
+      | 3  | 1  | 3 |
+
+  Scenario: round half up including negatives
+    Given an empty graph
+    When executing query:
+      """
+      RETURN round(0.5) AS a, round(1.5) AS b, round(-0.5) AS c,
+             round(-1.5) AS d, round(2.4) AS e
+      """
+    Then the result should be, in any order:
+      | a   | b   | c   | d    | e   |
+      | 1.0 | 2.0 | 0.0 | -1.0 | 2.0 |
+
+  Scenario: zero-length var expand binds source as target
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'})-[:R]->(b:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x:P)-[:R*0..0]->(y) RETURN x.n AS x, y.n AS y
+      """
+    Then the result should be, in any order:
+      | x   | y   |
+      | 'a' | 'a' |
+      | 'b' | 'b' |
+
+  Scenario: var expand over an empty graph region matches nothing
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'}), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x:P)-[:R*1..3]->(y) RETURN x.n AS x
+      """
+    Then the result should be, in any order:
+      | x |
+
+  Scenario: head last and tail on empty and null lists
+    Given an empty graph
+    When executing query:
+      """
+      RETURN head([]) AS a, last([]) AS b, tail([]) AS c,
+             head(null) AS d, tail(null) AS e
+      """
+    Then the result should be, in any order:
+      | a    | b    | c  | d    | e    |
+      | null | null | [] | null | null |
+
+  Scenario: range with step and descending direction
+    Given an empty graph
+    When executing query:
+      """
+      RETURN range(1, 5) AS a, range(0, 10, 3) AS b, range(5, 1, -2) AS c
+      """
+    Then the result should be, in any order:
+      | a               | b             | c         |
+      | [1, 2, 3, 4, 5] | [0, 3, 6, 9]  | [5, 3, 1] |
+
+  Scenario: substring boundaries
+    Given an empty graph
+    When executing query:
+      """
+      RETURN substring('hello', 1, 2) AS a, substring('hello', 3) AS b,
+             substring('hello', 0, 99) AS c, substring('', 0, 2) AS d
+      """
+    Then the result should be, in any order:
+      | a    | b    | c       | d  |
+      | 'el' | 'lo' | 'hello' | '' |
+
+  Scenario: left right and replace boundaries
+    Given an empty graph
+    When executing query:
+      """
+      RETURN left('abc', 99) AS a, right('abc', 2) AS b,
+             replace('aaa', 'a', 'b') AS c, replace('abc', 'x', 'y') AS d
+      """
+    Then the result should be, in any order:
+      | a     | b    | c     | d     |
+      | 'abc' | 'bc' | 'bbb' | 'abc' |
+
+  Scenario: trim family and case conversions
+    Given an empty graph
+    When executing query:
+      """
+      RETURN trim('  x  ') AS a, ltrim('  x') AS b, rtrim('x  ') AS c,
+             toUpper('mIx') AS d, toLower('mIx') AS e
+      """
+    Then the result should be, in any order:
+      | a   | b   | c   | d     | e     |
+      | 'x' | 'x' | 'x' | 'MIX' | 'mix' |
+
+  Scenario: reverse strings and lists
+    Given an empty graph
+    When executing query:
+      """
+      RETURN reverse('abc') AS a, reverse([1, 2, 3]) AS b, reverse([]) AS c
+      """
+    Then the result should be, in any order:
+      | a     | b         | c  |
+      | 'cba' | [3, 2, 1] | [] |
+
+  Scenario: split produces lists of strings
+    Given an empty graph
+    When executing query:
+      """
+      RETURN split('a,b,c', ',') AS a, split('abc', 'x') AS b
+      """
+    Then the result should be, in any order:
+      | a               | b       |
+      | ['a', 'b', 'c'] | ['abc'] |
+
+  Scenario: conversions compose with aggregation and WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({v: '10'}), ({v: '20'}), ({v: 'x'}), ({v: '30'})
+      """
+    When executing query:
+      """
+      MATCH (n) WITH toInteger(n.v) AS i WHERE i IS NOT NULL
+      RETURN count(i) AS c, sum(i) AS s, min(i) AS mn
+      """
+    Then the result should be, in any order:
+      | c | s  | mn |
+      | 3 | 60 | 10 |
+
+  Scenario: WITH plus WHERE over an aggregate acts as HAVING
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({g: 'a'}), ({g: 'a'}), ({g: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (n) WITH n.g AS g, count(*) AS c WHERE c > 1
+      RETURN g, c
+      """
+    Then the result should be, in any order:
+      | g   | c |
+      | 'a' | 2 |
+
+  Scenario: inverse trig outside the domain is null
+    Given an empty graph
+    When executing query:
+      """
+      RETURN asin(2.0) AS a, acos(-1.5) AS b, asin(1.0) AS c
+      """
+    Then the result should be, in any order:
+      | a    | b    | c                  |
+      | null | null | 1.5707963267948966 |
