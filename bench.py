@@ -71,10 +71,24 @@ def _remaining() -> float:
 
 
 def _on_alarm(signum, frame):
-    tag = ("deadline hit" if signum == getattr(signal, "SIGALRM", None)
-           else "terminated")
-    _result["metric"] += f" [{tag}; partial]"
-    _emit()
+    # Signal handlers run ON the interrupted thread: if that frame is
+    # inside _emit holding the (non-reentrant) lock, blocking here would
+    # deadlock and mutating the metric would mislabel the completed run.
+    # Non-blocking acquire: on failure the interrupted print is already
+    # in progress — return and let it finish.
+    global _printed
+    if not _emit_lock.acquire(blocking=False):
+        return
+    try:
+        if not _printed:
+            _printed = True
+            tag = ("deadline hit"
+                   if signum == getattr(signal, "SIGALRM", None)
+                   else "terminated")
+            _result["metric"] += f" [{tag}; partial]"
+            print(json.dumps(_result), flush=True)
+    finally:
+        _emit_lock.release()
     os._exit(0)
 
 

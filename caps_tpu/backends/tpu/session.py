@@ -66,6 +66,120 @@ class TPUCypherSession(RelationalCypherSession):
             status[str(d)] = ok
         return status
 
+    def shrink_and_reshard(self, healthy=None, graphs=None) -> int:
+        """Failure recovery (SURVEY.md §5.3): rebuild the mesh over the
+        surviving devices (largest power-of-two prefix — bucketed
+        capacities stay divisible) and re-place every device-resident
+        graph onto it.  Columns with an ingest host mirror re-place from
+        the mirror (a dead device's buffers are unreadable; the mirror
+        is the replica — durable snapshots live in the fs PGDS); columns
+        without one re-place device-to-device.  Compiled-program and
+        physical-layout caches keyed to the old placement (fused-count
+        closures, join sorts, CSR) are dropped/rebuilt.  Returns the new
+        shard count.
+
+        ``healthy``: surviving devices (default: health_check() == True).
+        ``graphs``: extra graphs to re-place beyond the session catalog
+        (e.g. ones created but never stored)."""
+        import numpy as np
+        from jax.sharding import Mesh
+        from caps_tpu.backends.tpu.column import Column
+        from caps_tpu.backends.tpu.table import DeviceTable
+        from caps_tpu.okapi.catalog import SessionGraphDataSource
+        import jax.numpy as jnp
+
+        backend = self.backend
+        old_mesh = backend.mesh
+        if healthy is None:
+            status = self.health_check()
+            pool = (list(old_mesh.devices.flat)
+                    if old_mesh is not None else [])
+            healthy = [d for d in pool if status.get(str(d), False)]
+        if not healthy:
+            raise RuntimeError("no healthy devices to reshard onto")
+
+        if old_mesh is not None and old_mesh.devices.ndim == 2:
+            # multi-slice: regroup survivors by their original DCN row so
+            # the rebuilt mesh keeps slice-contiguous placement (bulk
+            # collectives stay on ICI); rows shrink to the smallest
+            # surviving power-of-two width
+            by_row = {}
+            for r, row in enumerate(old_mesh.devices):
+                keep = [d for d in row if d in healthy]
+                if keep:
+                    by_row[r] = keep
+            width = 1 << (min(len(v) for v in by_row.values())
+                          .bit_length() - 1)
+            rows = [v[:width] for v in by_row.values()]
+            if len(rows) > 1:
+                backend.mesh = Mesh(np.array(rows),
+                                    ("dcn", backend.axis))
+            elif width > 1:
+                backend.mesh = Mesh(np.array(rows[0]), (backend.axis,))
+            else:
+                backend.mesh = None
+            survivors_flat = [d for r in rows for d in r]
+        else:
+            n = 1 << (len(healthy).bit_length() - 1)
+            backend.mesh = (Mesh(np.array(healthy[:n]), (backend.axis,))
+                            if n > 1 else None)
+            survivors_flat = healthy[:n]
+        target0 = survivors_flat[0]
+        backend.fused_count_static.clear()
+        backend.fused_count_fns.clear()
+
+        targets = list(graphs or [])
+        for ns in self.catalog.namespaces:
+            src = self.catalog.source(ns)
+            if isinstance(src, SessionGraphDataSource):
+                targets.extend(src.graph(g) for g in src.graph_names())
+
+        import jax
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(arr):
+            # explicit placement: jnp.asarray would stage on the DEFAULT
+            # device, which may be the dead one; with no mesh the single
+            # survivor is the target
+            arr = jnp.asarray(arr) if not hasattr(arr, "ndim") else arr
+            if (backend.mesh is not None and arr.ndim >= 1
+                    and arr.shape[0] % backend.n_shards == 0):
+                spec = ((tuple(backend.mesh.axis_names),)
+                        + (None,) * (arr.ndim - 1))
+                return jax.device_put(
+                    arr, NamedSharding(backend.mesh, P(*spec)))
+            return jax.device_put(arr, target0)
+
+        def replace(col: Column) -> Column:
+            if col.host is not None:
+                data, valid = col.host
+                return Column(col.kind, put(data), put(valid), col.ctype,
+                              col.lens if col.lens is None
+                              else put(col.lens), host=col.host)
+            # no mirror: device-to-device reshard (readable survivors
+            # only — truly lost buffers need the fs PGDS snapshot)
+            return Column(col.kind, put(col.data), put(col.valid),
+                          col.ctype,
+                          col.lens if col.lens is None
+                          else put(col.lens))
+
+        seen = set()
+        for g in targets:
+            for et in (tuple(getattr(g, "node_tables", ()))
+                       + tuple(getattr(g, "rel_tables", ()))):
+                t = et.table
+                if id(t) in seen or not isinstance(t, DeviceTable) \
+                        or t.is_local:
+                    continue
+                seen.add(id(t))
+                t._cols = {c: replace(col) for c, col in t._cols.items()}
+            for rt in getattr(g, "rel_tables", ()):
+                # rebuild the CSR physical layout on the new placement
+                self._factory.prepare_rel_table(rt)
+        return int(backend.mesh.devices.size) if backend.mesh is not None \
+            else 1
+
     @staticmethod
     def local(**kwargs) -> "TPUCypherSession":
         return TPUCypherSession(**kwargs)

@@ -92,3 +92,78 @@ def test_nondeterminism_error_surface(monkeypatch):
     monkeypatch.setattr(rs, "result_digest", lambda r: next(digests))
     with pytest.raises(NondeterministicResultError):
         g.cypher(QUERY)
+
+
+def test_shrink_and_reshard_after_device_loss():
+    """SURVEY.md §5.3: after a device failure the session rebuilds its
+    mesh over the survivors (power-of-two prefix), re-places catalog
+    graphs from their ingest host mirrors, rebuilds physical layouts
+    (CSR), and answers queries with unchanged results."""
+    from caps_tpu.backends.local.session import LocalCypherSession
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.okapi.config import EngineConfig
+    from caps_tpu.testing.bag import Bag
+    from caps_tpu.testing.factory import create_graph
+
+    create = ("CREATE (a:Person {name:'Ada'}), (b:Person {name:'Bo'}), "
+              "(c:Person {name:'Cy'}), (a)-[:KNOWS]->(b), "
+              "(b)-[:KNOWS]->(c), (a)-[:KNOWS]->(c)")
+    q = "MATCH (a)-[:KNOWS*1..2]->(b) RETURN a.name AS a, b.name AS b"
+    q2 = ("MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) "
+          "WHERE a.name='Ada' RETURN count(*) AS c")
+
+    sess = TPUCypherSession(config=EngineConfig(mesh_shape=(8,)))
+    g = create_graph(sess, create, {})
+    sess.catalog.store("g", g)
+    oracle = LocalCypherSession()
+    go = create_graph(oracle, create, {})
+    want = go.cypher(q).records.to_maps()
+    assert Bag(g.cypher(q).records.to_maps()) == want
+
+    # simulate losing 3 devices: 5 survivors -> power-of-two prefix = 4
+    survivors = list(sess.backend.mesh.devices.flat)[:5]
+    n = sess.shrink_and_reshard(healthy=survivors)
+    assert n == 4 and sess.backend.mesh.devices.size == 4
+
+    assert Bag(g.cypher(q).records.to_maps()) == want
+    assert g.cypher(q2).records.to_maps() == \
+        go.cypher(q2).records.to_maps()
+    assert sess.fallback_count == 0, sess.backend.fallback_reasons
+
+    # shrinking to one survivor degrades to single-device (mesh None)
+    n = sess.shrink_and_reshard(healthy=survivors[:1])
+    assert n == 1 and sess.backend.mesh is None
+    assert Bag(g.cypher(q).records.to_maps()) == want
+
+
+def test_shrink_and_reshard_two_level_mesh():
+    """Resharding a multi-slice (DCN x ICI) mesh regroups survivors by
+    slice: rows shrink to the smallest surviving power-of-two width and
+    the mesh stays two-level (no ring hops across DCN)."""
+    from caps_tpu.backends.local.session import LocalCypherSession
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.okapi.config import EngineConfig
+    from caps_tpu.testing.bag import Bag
+    from caps_tpu.testing.factory import create_graph
+
+    create = ("CREATE (a:P {v: 1}), (b:P {v: 2}), (c:P {v: 3}), "
+              "(a)-[:R]->(b), (b)-[:R]->(c)")
+    q = "MATCH (x:P)-[:R]->(y) RETURN x.v AS x, y.v AS y"
+    sess = TPUCypherSession(config=EngineConfig(mesh_shape=(2, 4)))
+    g = create_graph(sess, create, {})
+    sess.catalog.store("g", g)
+    want = create_graph(LocalCypherSession(), create, {}
+                        ).cypher(q).records.to_maps()
+    assert Bag(g.cypher(q).records.to_maps()) == want
+
+    # lose one device from the second slice: widths (4, 3) -> 2 each
+    old = sess.backend.mesh.devices
+    survivors = list(old[0]) + list(old[1][:3])
+    n = sess.shrink_and_reshard(healthy=survivors)
+    assert n == 4
+    assert sess.backend.mesh.devices.shape == (2, 2)
+    assert sess.backend.mesh.axis_names == ("dcn", "shard")
+    # every rebuilt row comes from one original slice
+    assert all(d in list(old[0]) for d in sess.backend.mesh.devices[0])
+    assert all(d in list(old[1]) for d in sess.backend.mesh.devices[1])
+    assert Bag(g.cypher(q).records.to_maps()) == want
