@@ -113,12 +113,14 @@ def make_ring_khop(mesh: Mesh, n_nodes: int, n_hops: int,
 
 
 def _ring_hop_matrix(f_block, edge_src, edge_dst, edge_ok, *, axis: str,
-                     n_nodes: int, n_shards: int):
+                     n_nodes: int, n_shards: int, edge_w=None):
     """One hop of the MATRIX frontier: ``f_block`` is the (seeds,
     node-block) slice of a per-seed path-count matrix F[s, v].  Blocks
     rotate around the ring exactly as in ``_ring_hop``; the seed axis
     stays local, so this is the general VarExpand frontier exchange — the
-    aggregate form above is the seeds==1 special case."""
+    aggregate form above is the seeds==1 special case.  ``edge_w``
+    weights each edge's contribution (the 3-hop isomorphism correction
+    applies weighted sparse hops)."""
     nb = n_nodes // n_shards
     n_seeds = f_block.shape[0]
     my = jax.lax.axis_index(axis)
@@ -130,7 +132,10 @@ def _ring_hop_matrix(f_block, edge_src, edge_dst, edge_ok, *, axis: str,
         lo = block_id * nb
         m = edge_ok & (edge_src >= lo) & (edge_src < lo + nb)
         local = jnp.clip(edge_src - lo, 0, nb - 1)
-        acc = acc + jnp.where(m[None, :], blk[:, local], 0)
+        contrib = blk[:, local]
+        if edge_w is not None:
+            contrib = contrib * edge_w[None, :]
+        acc = acc + jnp.where(m[None, :], contrib, 0)
         blk = jax.lax.ppermute(blk, axis, perm)
         return blk, acc
 
@@ -186,14 +191,9 @@ def make_ring_varexpand(mesh: Mesh, n_nodes: int, lengths: tuple,
             f = hop(f, edge_src, edge_dst, edge_ok)
             if length == 2:
                 # relationship-isomorphism correction on the diagonal
-                # (see docstring); counted by src so both modes share
-                # one collective
-                if correction == "loops":
-                    bad = edge_ok & (edge_src == edge_dst)
-                else:
-                    bad = edge_ok
-                loc = jax.ops.segment_sum(
-                    bad.astype(f.dtype), edge_src, num_segments=n_nodes)
+                # (see docstring)
+                loc = _r2_vector(edge_src, edge_dst, edge_ok, n_nodes,
+                                 f.dtype, correction)
                 corr = jax.lax.psum_scatter(loc, axis, scatter_dimension=0,
                                             tiled=True)  # (nb,)
                 f = f - f0_block * corr[None, :]
@@ -206,6 +206,203 @@ def make_ring_varexpand(mesh: Mesh, n_nodes: int, lengths: tuple,
                                  P(axis)),
                        out_specs=P(None, axis))
     return jax.jit(mapped)
+
+
+def _r2_vector(edge_src, edge_dst, edge_ok, n_nodes, dtype,
+               correction: str):
+    """Per-node reuse-pair count: self-loops (uniform direction) or the
+    symmetrized degree (undirected) — the length-2 isomorphism
+    correction vector, also the A12/A23 factor of the 3-hop one."""
+    if correction == "loops":
+        bad = edge_ok & (edge_src == edge_dst)
+    else:
+        bad = edge_ok
+    return jax.ops.segment_sum(bad.astype(dtype), edge_src,
+                               num_segments=n_nodes)
+
+
+def make_ring_varexpand3(mesh: Mesh, n_nodes: int, lengths: tuple,
+                         axis: str = "shard", correction: str = "loops"):
+    """Ring-scheduled var-expand for lengths up to 3.  Walk counts are
+    SpMV hops; relationship isomorphism is restored per length:
+
+        P2 = W2 − F0·r2                                (reuse at start)
+        P3 = W3 − A12 − A23 − A13 + 2T   (inclusion–exclusion over the
+                                          pairs (1,2), (2,3), (1,3);
+                                          every pairwise intersection is
+                                          the all-equal triple T)
+        A12 = H(F0 ⊙ r2)        — same-rel pair first, any third hop
+        A23 = H(F0) ⊙ r2        — any first hop, same-rel pair after
+        A13 = H_sp13(F0)        — first rel reused as third; the free
+                                  middle hop's count is folded into a
+                                  host-built weighted sparse hop
+        T   = H_spT(F0)         — all three the same rel
+
+    Extra inputs beyond make_ring_varexpand's: the two weighted sparse
+    edge lists (sp13/spT as (src, dst, w) triples, edge-sharded)."""
+    n_shards = int(mesh.devices.size)
+    if n_nodes % n_shards:
+        raise ValueError(f"n_nodes {n_nodes} must divide over {n_shards}")
+    if correction not in ("loops", "degree"):
+        raise ValueError(correction)
+    max_len = max(lengths) if lengths else 0
+    if max_len != 3:
+        raise ValueError("use make_ring_varexpand for lengths <= 2")
+    hop = functools.partial(_ring_hop_matrix, axis=axis, n_nodes=n_nodes,
+                            n_shards=n_shards)
+
+    def body(f0, e_src, e_dst, e_ok, tmask, s13_src, s13_dst, s13_w,
+             st_src, st_dst, st_w):
+        loc = _r2_vector(e_src, e_dst, e_ok, n_nodes, f0.dtype, correction)
+        r2 = jax.lax.psum_scatter(loc, axis, scatter_dimension=0,
+                                  tiled=True)  # (nb,) node-block sharded
+        out = jnp.zeros_like(f0)
+        if 0 in lengths:
+            out = out + f0 * tmask[None, :]
+        f1 = hop(f0, e_src, e_dst, e_ok)
+        if 1 in lengths:
+            out = out + f1 * tmask[None, :]
+        f2 = hop(f1, e_src, e_dst, e_ok)
+        if 2 in lengths:
+            out = out + (f2 - f0 * r2[None, :]) * tmask[None, :]
+        f3 = hop(f2, e_src, e_dst, e_ok)
+        a12 = hop(f0 * r2[None, :], e_src, e_dst, e_ok)
+        a23 = f1 * r2[None, :]
+        a13 = hop(f0, s13_src, s13_dst, s13_w > 0, edge_w=s13_w)
+        t3 = hop(f0, st_src, st_dst, st_w > 0, edge_w=st_w)
+        p3 = f3 - a12 - a23 - a13 + 2 * t3
+        return out + p3 * tmask[None, :]
+
+    mapped = shard_map(body, mesh=mesh,
+                       in_specs=(P(None, axis),) + (P(axis),) * 10,
+                       out_specs=P(None, axis))
+    return jax.jit(mapped)
+
+
+def ring_varexpand3_reference(f0, edge_src, edge_dst, edge_ok, tmask,
+                              lengths: tuple, s13, st,
+                              correction: str = "loops"):
+    """Single-device jnp twin of make_ring_varexpand3 (``s13``/``st`` are
+    (src, dst, w) array triples)."""
+    n_nodes = f0.shape[1]
+
+    def hop(f, src, dst, ok, w=None):
+        per_edge = jnp.where(ok[None, :], f[:, src], 0)
+        if w is not None:
+            per_edge = per_edge * w[None, :]
+        return jax.ops.segment_sum(per_edge.T, dst,
+                                   num_segments=n_nodes).T
+
+    r2 = _r2_vector(edge_src, edge_dst, edge_ok, n_nodes, f0.dtype,
+                    correction)
+    out = jnp.zeros_like(f0)
+    if 0 in lengths:
+        out = out + f0 * tmask[None, :]
+    f1 = hop(f0, edge_src, edge_dst, edge_ok)
+    if 1 in lengths:
+        out = out + f1 * tmask[None, :]
+    f2 = hop(f1, edge_src, edge_dst, edge_ok)
+    if 2 in lengths:
+        out = out + (f2 - f0 * r2[None, :]) * tmask[None, :]
+    f3 = hop(f2, edge_src, edge_dst, edge_ok)
+    a12 = hop(f0 * r2[None, :], edge_src, edge_dst, edge_ok)
+    a23 = f1 * r2[None, :]
+    a13 = hop(f0, s13[0], s13[1], s13[2] > 0, w=s13[2])
+    t3 = hop(f0, st[0], st[1], st[2] > 0, w=st[2])
+    return out + (f3 - a12 - a23 - a13 + 2 * t3) * tmask[None, :]
+
+
+@functools.lru_cache(maxsize=128)
+def ring_varexpand3_cached(mesh: Mesh, n_nodes: int, lengths: tuple,
+                           axis: str = "shard",
+                           correction: str = "loops"):
+    return make_ring_varexpand3(mesh, n_nodes, lengths, axis, correction)
+
+
+@functools.lru_cache(maxsize=32)
+def ring_varexpand3_single(lengths: tuple, correction: str = "loops"):
+    @jax.jit
+    def fn(f0, edge_src, edge_dst, edge_ok, tmask, s13_src, s13_dst,
+           s13_w, st_src, st_dst, st_w):
+        return ring_varexpand3_reference(
+            f0, edge_src, edge_dst, edge_ok, tmask, lengths,
+            (s13_src, s13_dst, s13_w), (st_src, st_dst, st_w), correction)
+
+    return fn
+
+
+def build_iso3_sparse(frm, to, rid, n_nodes: int):
+    """Host-side weighted sparse edge lists for the 3-hop correction.
+
+    ``frm``/``to``/``rid`` describe the ENTRY list the hops traverse
+    (symmetrized for undirected patterns; each entry carries its
+    underlying relationship id).  Returns (sp13, spT) as (src, dst, w)
+    numpy triples:
+
+      * sp13: for each ordered orientation pair (o1, o3) of one
+        relationship, an edge from(o1) -> to(o3) weighted by the number
+        of entries that can serve as the free middle hop
+        to(o1) -> from(o3);
+      * spT: for each orientation chain o1 -> o2 -> o3 of one
+        relationship, an edge from(o1) -> to(o3) with weight 1.
+    """
+    import numpy as np
+    frm = np.asarray(frm, dtype=np.int64)
+    to = np.asarray(to, dtype=np.int64)
+    rid = np.asarray(rid, dtype=np.int64)
+
+    # entry-count lookup between ordered node pairs
+    keys = np.sort(frm * n_nodes + to)
+
+    def cnt(x, y):
+        q = x * n_nodes + y
+        return (np.searchsorted(keys, q, side="right")
+                - np.searchsorted(keys, q, side="left"))
+
+    # group entries by relationship id: 1 orientation (directed or a
+    # loop) or 2 (undirected non-loop)
+    order = np.argsort(rid, kind="stable")
+    r_sorted = rid[order]
+    first = np.ones(len(rid), dtype=bool)
+    first[1:] = r_sorted[1:] != r_sorted[:-1]
+    starts = np.nonzero(first)[0]
+    counts = np.diff(np.append(starts, len(rid)))
+
+    s13_s, s13_d, s13_w = [], [], []
+    st_s, st_d, st_w = [], [], []
+    one = starts[counts == 1]
+    u1, v1 = frm[order[one]], to[order[one]]
+    # single-orientation rels: (o1, o3) = (e, e); chain o1->o2->o3 needs
+    # o2 = e too, which chains only for loops
+    s13_s.append(u1)
+    s13_d.append(v1)
+    s13_w.append(cnt(v1, u1))
+    lo = u1 == v1
+    st_s.append(u1[lo])
+    st_d.append(v1[lo])
+    st_w.append(np.ones(int(lo.sum()), dtype=np.int64))
+    two = starts[counts == 2]
+    if len(two):
+        ua, va = frm[order[two]], to[order[two]]        # orientation uv
+        # orientation pairs (see make_ring_varexpand3 docstring)
+        s13_s.append(np.concatenate([ua, ua, va, va]))
+        s13_d.append(np.concatenate([va, ua, va, ua]))
+        s13_w.append(np.concatenate([cnt(va, ua), cnt(va, va),
+                                     cnt(ua, ua), cnt(ua, va)]))
+        # chains: u -e- v -e- u -e- v and the reverse
+        st_s.append(np.concatenate([ua, va]))
+        st_d.append(np.concatenate([va, ua]))
+        st_w.append(np.ones(2 * len(two), dtype=np.int64))
+
+    def pack(ss, dd, ww):
+        s = np.concatenate(ss) if ss else np.zeros(0, np.int64)
+        d = np.concatenate(dd) if dd else np.zeros(0, np.int64)
+        w = np.concatenate(ww) if ww else np.zeros(0, np.int64)
+        keep = w > 0
+        return (s[keep].astype(np.int32), d[keep].astype(np.int32),
+                w[keep])
+
+    return pack(s13_s, s13_d, s13_w), pack(st_s, st_d, st_w)
 
 
 def ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok, tmask,
@@ -221,12 +418,8 @@ def ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok, tmask,
         f = jax.ops.segment_sum(per_edge.T, edge_dst,
                                 num_segments=n_nodes).T
         if length == 2:
-            if correction == "loops":
-                bad = edge_ok & (edge_src == edge_dst)
-            else:
-                bad = edge_ok
-            corr = jax.ops.segment_sum(bad.astype(f.dtype), edge_src,
-                                       num_segments=n_nodes)
+            corr = _r2_vector(edge_src, edge_dst, edge_ok, n_nodes,
+                              f.dtype, correction)
             f = f - f0 * corr[None, :]
         if length in lengths:
             out = out + f * tmask[None, :]

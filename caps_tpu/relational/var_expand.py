@@ -133,7 +133,7 @@ class VarExpandOp(RelationalOperator):
         (parallel/ring.py make_ring_varexpand); single-chip it runs the
         same SpMV hops as one jitted program (the twin) — either way the
         join cascade and its per-hop materializations disappear."""
-        if self.rel_needed or self.into or self.upper > 2:
+        if self.rel_needed or self.into or self.upper > 3:
             return None
         backend = getattr(self.context.factory, "backend", None)
         if backend is None or not backend.config.use_ring:
@@ -144,7 +144,9 @@ class VarExpandOp(RelationalOperator):
         from caps_tpu.backends.tpu.table import DeviceTable
         from caps_tpu.okapi.types import CTInteger
         from caps_tpu.parallel.ring import (
-            ring_varexpand_cached, ring_varexpand_single,
+            build_iso3_sparse, ring_varexpand3_cached,
+            ring_varexpand3_single, ring_varexpand_cached,
+            ring_varexpand_single,
         )
 
         parent_header, parent_table = self.children[0].result
@@ -220,15 +222,21 @@ class VarExpandOp(RelationalOperator):
                 else (etgt, esrc)
             ok_cat = eok
             correction = "loops"
-        e_pad = max((((a.shape[0] + n_shards - 1) // n_shards)
-                     * n_shards), n_shards)
+        def shard_pad(length: int) -> int:
+            return max(((length + n_shards - 1) // n_shards) * n_shards,
+                       n_shards)
+
+        e_pad = shard_pad(a.shape[0])
         # peak working set is the per-hop (seeds, edges) gather — bound
         # it like the (seeds, nodes) frontier.  Only the 1-D ring path
         # splits edges across devices; single-chip and 2-D meshes run
-        # the whole gather on one device's program.
+        # the whole gather on one device's program.  The 3-hop sparse
+        # correction hops gather up to 4 entries per rel (vs <= 2 in the
+        # base list), so bound the widest list the program will touch.
         on_ring = (backend.mesh is not None
                    and backend.mesh.devices.ndim == 1)
-        edges_per_device = e_pad // n_shards if on_ring else e_pad
+        widest = e_pad * 2 if self.upper == 3 else e_pad
+        edges_per_device = widest // n_shards if on_ring else widest
         if n_seeds * edges_per_device > self._RING_MAX_MATRIX:
             return None
         frm = np.zeros(e_pad, dtype=np.int32)
@@ -238,15 +246,55 @@ class VarExpandOp(RelationalOperator):
         to[:b.shape[0]] = np.where(ok_cat, b, 0)
         okp[:ok_cat.shape[0]] = ok_cat
 
-        if on_ring:
-            fn = ring_varexpand_cached(backend.mesh, n_pad, lengths,
-                                       backend.axis, correction)
+        if self.upper == 3:
+            # 3-hop isomorphism correction needs the entries' underlying
+            # relationship ids (host-side sparse-hop build)
+            rids = self._host_arrays(rel_t, rel_header.column(rv))
+            if rids is None:
+                return None
+            rid_all = rids[0]
+            if self.direction == Direction.BOTH:
+                rid_cat = np.concatenate([rid_all, rid_all[nonloop]])
+            else:
+                rid_cat = rid_all
+            keep = ok_cat
+            sp13, spt = build_iso3_sparse(
+                np.asarray(a)[keep], np.asarray(b)[keep], rid_cat[keep],
+                n_pad)
+
+            def pad_sparse(tr):
+                s, d, w = tr
+                p = shard_pad(s.shape[0])
+                ps = np.zeros(p, dtype=np.int32)
+                pd = np.zeros(p, dtype=np.int32)
+                pw = np.zeros(p, dtype=np.int64)
+                ps[:s.shape[0]] = s
+                pd[:d.shape[0]] = d
+                pw[:w.shape[0]] = w
+                return ps, pd, pw
+
+            s13s, s13d, s13w = pad_sparse(sp13)
+            sts, std_, stw = pad_sparse(spt)
+            if on_ring:
+                fn = ring_varexpand3_cached(backend.mesh, n_pad, lengths,
+                                            backend.axis, correction)
+            else:
+                fn = ring_varexpand3_single(lengths, correction)
+            m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
+                   jnp.asarray(okp), jnp.asarray(tmask),
+                   jnp.asarray(s13s), jnp.asarray(s13d),
+                   jnp.asarray(s13w), jnp.asarray(sts),
+                   jnp.asarray(std_), jnp.asarray(stw))
         else:
-            # single chip, or a 2-D (DCN x ICI) mesh where the GSPMD
-            # partitioner schedules the collectives
-            fn = ring_varexpand_single(lengths, correction)
-        m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
-               jnp.asarray(okp), jnp.asarray(tmask))
+            if on_ring:
+                fn = ring_varexpand_cached(backend.mesh, n_pad, lengths,
+                                           backend.axis, correction)
+            else:
+                # single chip, or a 2-D (DCN x ICI) mesh where the GSPMD
+                # partitioner schedules the collectives
+                fn = ring_varexpand_single(lengths, correction)
+            m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
+                   jnp.asarray(okp), jnp.asarray(tmask))
         counts = m.reshape(-1)
         total = backend.consume_count(counts.sum())
         out_cap = backend.bucket(total)

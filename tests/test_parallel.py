@@ -179,8 +179,10 @@ def test_varexpand_rides_ring_on_mesh():
          "ring-matrix"),
         ("MATCH (a)-[*0..2]-(b:Person) RETURN b.name AS b",
          "ring-matrix"),
-        # upper > 2 -> join path
         ("MATCH (a)-[:KNOWS*1..3]->(b) RETURN a.name AS a, b.name AS b",
+         "ring-matrix"),
+        # beyond the 3-hop correction bound -> join path
+        ("MATCH (a)-[:KNOWS*1..4]->(b) RETURN a.name AS a, b.name AS b",
          "join"),
     ]
     for q, want_strategy in cases:
@@ -309,3 +311,94 @@ def test_two_level_mesh_parity():
     ve = [m for m in res.metrics["operators"] if m["op"] == "VarExpand"]
     assert ve and ve[0]["strategy"] == "matrix", ve
     assert multi.fallback_count == 0, multi.backend.fallback_reasons
+
+
+def test_varexpand_matrix_three_hops_oracle(mesh):
+    """*1..3 / *3..3 / *0..3 on the matrix path — the 3-hop
+    relationship-isomorphism inclusion-exclusion (W3 - A12 - A23 - A13
+    + 2T) — against the join-path oracle, on a multigraph with
+    self-loops and parallel edges, in all three directions, single-chip
+    and ring."""
+    from caps_tpu.backends.local.session import LocalCypherSession
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.okapi.config import EngineConfig
+    from caps_tpu.testing.bag import Bag
+    from caps_tpu.testing.factory import create_graph
+
+    rng = np.random.RandomState(5)
+    n = 7
+    parts = [f"(n{i}:P {{v: {i}}})" for i in range(n)]
+    edges = []
+    for _ in range(14):
+        u, v = rng.randint(0, n), rng.randint(0, n)
+        edges.append(f"(n{u})-[:K]->(n{v})")
+    edges += ["(n0)-[:K]->(n0)",                    # self-loop
+              "(n1)-[:K]->(n2)", "(n1)-[:K]->(n2)"]  # parallel edges
+    create = "CREATE " + ", ".join(parts + edges)
+
+    oracle = LocalCypherSession()
+    single = TPUCypherSession()
+    sharded = TPUCypherSession(config=EngineConfig(mesh_shape=(8,)))
+    go = create_graph(oracle, create, {})
+    gt = create_graph(single, create, {})
+    gs = create_graph(sharded, create, {})
+    for pat in ["-[:K*1..3]->", "<-[:K*1..3]-", "-[:K*1..3]-",
+                "-[:K*3..3]->", "-[:K*0..3]-", "-[:K*2..3]-"]:
+        q = f"MATCH (a){pat}(b) RETURN a.v AS a, b.v AS b"
+        want = go.cypher(q).records.to_maps()
+        for name, g, strat in (("single", gt, "matrix"),
+                               ("sharded", gs, "ring-matrix")):
+            res = g.cypher(q)
+            assert Bag(res.records.to_maps()) == Bag(want), (name, pat)
+            ve = [m for m in res.metrics["operators"]
+                  if m["op"] == "VarExpand"]
+            assert ve[0]["strategy"] == strat, (name, pat, ve)
+    assert single.fallback_count == 0 and sharded.fallback_count == 0
+
+
+def test_ring_varexpand3_kernel_vs_twin(mesh):
+    """Sharded 3-hop program vs the single-device twin on random
+    weighted sparse corrections."""
+    from caps_tpu.parallel.ring import (
+        build_iso3_sparse, make_ring_varexpand3,
+        ring_varexpand3_reference,
+    )
+
+    n_nodes, n_rels = 16, 30
+    rng = np.random.RandomState(2)
+    src = rng.randint(0, n_nodes, n_rels).astype(np.int32)
+    dst = rng.randint(0, n_nodes, n_rels).astype(np.int32)
+    src[:4] = dst[:4]
+    rid = np.arange(n_rels)
+    nonloop = src != dst
+    frm = np.concatenate([src, dst[nonloop]]).astype(np.int32)
+    to = np.concatenate([dst, src[nonloop]]).astype(np.int32)
+    rids = np.concatenate([rid, rid[nonloop]])
+    sp13, spt = build_iso3_sparse(frm, to, rids, n_nodes)
+
+    def pad(xs, fill=0):
+        p = (-len(xs[0])) % 8
+        return tuple(np.concatenate([x, np.full(p, fill, x.dtype)])
+                     for x in xs)
+
+    frm_p, to_p = pad((frm, to))
+    ok_p = np.arange(len(frm_p)) < len(frm)
+    sp13_p = pad(sp13)
+    spt_p = pad(spt)
+    f0 = np.eye(n_nodes, dtype=np.int64)
+    tmask = np.ones(n_nodes, dtype=np.int64)
+
+    fn = make_ring_varexpand3(mesh, n_nodes, (1, 2, 3),
+                              correction="degree")
+    got = np.asarray(fn(jnp.asarray(f0), jnp.asarray(frm_p),
+                        jnp.asarray(to_p), jnp.asarray(ok_p),
+                        jnp.asarray(tmask),
+                        *[jnp.asarray(x) for x in sp13_p],
+                        *[jnp.asarray(x) for x in spt_p]))
+    want = np.asarray(ring_varexpand3_reference(
+        jnp.asarray(f0), jnp.asarray(frm_p), jnp.asarray(to_p),
+        jnp.asarray(ok_p), jnp.asarray(tmask), (1, 2, 3),
+        tuple(jnp.asarray(x) for x in sp13_p),
+        tuple(jnp.asarray(x) for x in spt_p), correction="degree"))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() > 0
