@@ -189,14 +189,14 @@ def _make_data(scale: float, seed: int) -> LdbcData:
 
     return LdbcData(
         person_ids, person_first, person_last, person_city, person_birthday,
-        person_creation, city_ids, list(np.array(_CITIES)[:n_city]),
+        person_creation, city_ids, [str(c) for c in _CITIES[:n_city]],
         forum_ids, [f"Forum {i}" for i in range(n_forum)], forum_moderator,
         post_ids, post_creator, post_forum, post_creation,
         comment_ids, comment_creator, comment_parent_post,
         comment_parent_comment, comment_root_post, comment_creation,
         knows_src, knows_dst, knows_creation,
-        tag_ids, list(np.array(_TAGS)[:n_tag]), post_tag_post, post_tag_tag,
-        company_ids, list(np.array(_COMPANIES)[:n_company]),
+        tag_ids, [str(t) for t in _TAGS[:n_tag]], post_tag_post, post_tag_tag,
+        company_ids, [str(c) for c in _COMPANIES[:n_company]],
         work_person, work_company, work_from,
         likes_person, likes_is_post, likes_target, likes_creation)
 

@@ -284,6 +284,8 @@ def ring_varexpand3_reference(f0, edge_src, edge_dst, edge_ok, tmask,
                               correction: str = "loops"):
     """Single-device jnp twin of make_ring_varexpand3 (``s13``/``st`` are
     (src, dst, w) array triples)."""
+    if (max(lengths) if lengths else 0) != 3:
+        raise ValueError("use ring_varexpand_reference for lengths <= 2")
     n_nodes = f0.shape[1]
 
     def hop(f, src, dst, ok, w=None):
@@ -370,6 +372,12 @@ def build_iso3_sparse(frm, to, rid, n_nodes: int):
 
     s13_s, s13_d, s13_w = [], [], []
     st_s, st_d, st_w = [], [], []
+    if counts.size and int(counts.max()) > 2:
+        # a rel id appearing 3+ times means a malformed entry list
+        # (e.g. double symmetrization); an omitted correction would be a
+        # silent wrong answer, so fail loudly
+        raise ValueError("entry list has a relationship id with more "
+                         "than two orientations")
     one = starts[counts == 1]
     u1, v1 = frm[order[one]], to[order[one]]
     # single-orientation rels: (o1, o3) = (e, e); chain o1->o2->o3 needs

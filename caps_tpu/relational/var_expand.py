@@ -250,7 +250,9 @@ class VarExpandOp(RelationalOperator):
             # 3-hop isomorphism correction needs the entries' underlying
             # relationship ids (host-side sparse-hop build)
             rids = self._host_arrays(rel_t, rel_header.column(rv))
-            if rids is None:
+            if rids is None or not bool(np.all(rids[1] >= eok)):
+                # the id column must be valid wherever the endpoints are
+                # (a garbage id would corrupt the orientation grouping)
                 return None
             rid_all = rids[0]
             if self.direction == Direction.BOTH:

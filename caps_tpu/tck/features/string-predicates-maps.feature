@@ -1,0 +1,121 @@
+Feature: String predicates, regex, maps and keys
+  # openCypher STARTS WITH / ENDS WITH / CONTAINS / =~ three-valued
+  # semantics, map literals and key access, keys()/properties().
+
+  Scenario: STARTS WITH ENDS WITH CONTAINS basics
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({s: 'Carlsberg'}), ({s: 'carl'}), ({s: 'Berg'}), ({t: 1})
+      """
+    When executing query:
+      """
+      MATCH (n) WHERE n.s STARTS WITH 'Carl' RETURN n.s AS s
+      """
+    Then the result should be, in any order:
+      | s           |
+      | 'Carlsberg' |
+
+  Scenario: CONTAINS and ENDS WITH are case sensitive
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({s: 'Carlsberg'}), ({s: 'carlsberg'})
+      """
+    When executing query:
+      """
+      MATCH (n) WHERE n.s CONTAINS 'lsb' AND n.s ENDS WITH 'berg'
+      RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 2 |
+
+  Scenario: string predicates on null or non-existent are null-filtered
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({s: 'abc'}), ({t: 1})
+      """
+    When executing query:
+      """
+      MATCH (n) WHERE n.s STARTS WITH 'a' RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 1 |
+
+  Scenario: regex match with =~
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({s: 'mail-42'}), ({s: 'mail-x'}), ({s: 'other'})
+      """
+    When executing query:
+      """
+      MATCH (n) WHERE n.s =~ 'mail-[0-9]+' RETURN n.s AS s
+      """
+    Then the result should be, in any order:
+      | s         |
+      | 'mail-42' |
+
+  Scenario: map literal projection and nested access
+    Given an empty graph
+    When executing query:
+      """
+      WITH {a: 1, b: {c: 'x'}} AS m
+      RETURN m.a AS a, m.b.c AS c
+      """
+    Then the result should be, in any order:
+      | a | c   |
+      | 1 | 'x' |
+
+  Scenario: keys of a node and of a map
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({name: 'n', age: 3})
+      """
+    When executing query:
+      """
+      MATCH (n) UNWIND keys(n) AS k
+      RETURN k ORDER BY k
+      """
+    Then the result should be, in order:
+      | k      |
+      | 'age'  |
+      | 'name' |
+
+  Scenario: properties() materializes the property map
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({name: 'n', age: 3})
+      """
+    When executing query:
+      """
+      MATCH (n) WITH properties(n) AS p
+      RETURN p.name AS name, p.age AS age
+      """
+    Then the result should be, in any order:
+      | name | age |
+      | 'n'  | 3   |
+
+  Scenario: CASE over string predicate results
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({s: 'alpha'}), ({s: 'beta'}), ({t: 0})
+      """
+    When executing query:
+      """
+      MATCH (n)
+      RETURN CASE WHEN n.s STARTS WITH 'a' THEN 'A'
+                  WHEN n.s IS NULL THEN 'none'
+                  ELSE 'other' END AS tag, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | tag     | c |
+      | 'A'     | 1 |
+      | 'none'  | 1 |
+      | 'other' | 1 |
