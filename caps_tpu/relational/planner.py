@@ -41,54 +41,136 @@ class RelationalPlanner:
         # everything is used); lets VarExpand prove its rel var dead and
         # take the ring-matrix path (var_expand.py module docstring).
         self._used_names: Opt[frozenset] = None
+        # Names whose only reads are size()/length() — a var-length rel
+        # list read that way is served by a PATH-LENGTH column instead,
+        # keeping the query on the matrix path (e.g. LDBC IC13/IC14's
+        # min(size(r))).  _fix() rewrites those reads in consumers.
+        self._size_only_ok: frozenset = frozenset()
+        self._len_names: Dict[str, str] = {}
 
     def fresh(self, prefix: str) -> str:
         self._fresh += 1
         return f"__{prefix}_{self._fresh}"
 
+    def _fix(self, e: E.Expr) -> E.Expr:
+        """Rewrite size(rel)/length(rel) reads of a size-only var-length
+        rel variable to its path-length column (see _len_names)."""
+        if not self._len_names:
+            return e
+
+        def repl(x):
+            if (isinstance(x, E.FunctionExpr)
+                    and x.name.lower() in ("size", "length")
+                    and len(x.args) == 1 and isinstance(x.args[0], E.Var)
+                    and x.args[0].name in self._len_names):
+                return E.Var(self._len_names[x.args[0].name])
+            return x
+
+        return e.transform_up(repl)
+
     def process(self, plan: L.LogicalPlan) -> R.RelationalOperator:
-        self._used_names = self._collect_used_names(plan.root)
+        self._used_names, self._size_only_ok = \
+            self._collect_used_names(plan.root)
         return self.plan_op(plan.root)
 
     @staticmethod
-    def _collect_used_names(root: L.LogicalOperator) -> Opt[frozenset]:
-        """Every name read by an expression or selection in the plan.
-        Returns None (= treat all names as used) when the plan contains
+    def _op_exprs(op):
+        """The expression trees one logical operator carries."""
+        if isinstance(op, L.Filter):
+            return (op.predicate,)
+        if isinstance(op, L.Project):
+            return tuple(e for _, e in op.items)
+        if isinstance(op, L.Aggregate):
+            return (tuple(e for _, e in op.group)
+                    + tuple(a for _, a in op.aggregations))
+        if isinstance(op, L.OrderBy):
+            return tuple(e for e, _ in op.items)
+        if isinstance(op, (L.Skip, L.Limit)):
+            return (op.expr,)
+        if isinstance(op, L.Unwind):
+            return (op.list_expr,)
+        if isinstance(op, L.ValueJoin):
+            return tuple(op.predicates)
+        return ()
+
+    @staticmethod
+    def _collect_used_names(root: L.LogicalOperator):
+        """(used, size_only): every name read by an expression or
+        selection in the plan, and the subset whose EVERY read is
+        ``size(name)``/``length(name)`` (those reads can be served by a
+        path-length column instead of the materialized value).  used is
+        None (= treat all names as used) when the plan contains
         operators whose name flow this walk doesn't model (CONSTRUCT
         patterns carry var references outside the Expr tree)."""
         used = set()
+        selected = set()
+        total: dict = {}
+        wrapped: dict = {}
+        varlen_binds: dict = {}
+        other_binds = set()
         conservative = False
+        has_exists = False
+
+        def count_expr(e):
+            nonlocal has_exists
+            if isinstance(e, E.Var):
+                total[e.name] = total.get(e.name, 0) + 1
+            if isinstance(e, E.ExistsSubQuery):
+                # the subquery pattern introduces its own scope this
+                # name-level analysis does not model
+                has_exists = True
+            if (isinstance(e, E.FunctionExpr)
+                    and e.name.lower() in ("size", "length")
+                    and len(e.args) == 1 and isinstance(e.args[0], E.Var)):
+                n = e.args[0].name
+                wrapped[n] = wrapped.get(n, 0) + 1
+            for c in e.children:
+                if isinstance(c, E.Expr):
+                    count_expr(c)
 
         def walk(op):
             nonlocal conservative
             if isinstance(op, (L.ConstructGraph, L.ReturnGraph)):
                 conservative = True
-            exprs = []
-            if isinstance(op, L.Filter):
-                exprs.append(op.predicate)
-            elif isinstance(op, L.Project):
-                exprs.extend(e for _, e in op.items)
-            elif isinstance(op, L.Select):
+            if isinstance(op, L.Select):
                 used.update(op.names)
-            elif isinstance(op, L.Aggregate):
-                exprs.extend(e for _, e in op.group)
-                exprs.extend(a for _, a in op.aggregations)
-            elif isinstance(op, L.OrderBy):
-                exprs.extend(e for e, _ in op.items)
-            elif isinstance(op, (L.Skip, L.Limit)):
-                exprs.append(op.expr)
+                selected.update(op.names)
+            # binding sites: a size-only rewrite is sound only when the
+            # name has exactly ONE binding in the whole plan and it is a
+            # var-length rel — same-named bindings in sibling scopes
+            # (UNION branches, UNWIND) would otherwise be rewritten to a
+            # length column their branch does not have
+            if isinstance(op, L.BoundedVarLengthExpand):
+                varlen_binds[op.rel] = varlen_binds.get(op.rel, 0) + 1
+                other_binds.add(op.target)
+            elif isinstance(op, (L.NodeScan,)):
+                other_binds.add(op.var)
+            elif isinstance(op, L.Expand):
+                other_binds.update((op.rel, op.target))
             elif isinstance(op, L.Unwind):
-                exprs.append(op.list_expr)
-            elif isinstance(op, L.ValueJoin):
-                exprs.extend(op.predicates)
-            for e in exprs:
+                other_binds.add(op.var)
+            elif isinstance(op, L.Project):
+                other_binds.update(n for n, _ in op.items)
+            elif isinstance(op, L.Aggregate):
+                other_binds.update(n for n, _ in op.group)
+                other_binds.update(n for n, _ in op.aggregations)
+            for e in RelationalPlanner._op_exprs(op):
                 used.update(v.name for v in E.vars_in(e))
+                count_expr(e)
             for c in op.children:
                 if isinstance(c, L.LogicalOperator):
                     walk(c)
 
         walk(root)
-        return None if conservative else frozenset(used)
+        if conservative:
+            return None, frozenset()
+        if has_exists:
+            return frozenset(used), frozenset()
+        size_only = frozenset(
+            n for n, t in total.items()
+            if wrapped.get(n, 0) == t and n not in selected
+            and varlen_binds.get(n, 0) == 1 and n not in other_binds)
+        return frozenset(used), size_only
 
     # ------------------------------------------------------------------
 
@@ -117,16 +199,27 @@ class RelationalPlanner:
             parent = self.plan_op(op.parent)
             rel_needed = (self._used_names is None
                           or op.rel in self._used_names)
+            emit_len = None
+            if rel_needed and op.rel in self._size_only_ok:
+                # every read is size(rel)/length(rel): emit a path-length
+                # column and rewrite those reads to it — the rel list
+                # itself need not materialize
+                emit_len = f"__{op.rel}_len"
+                self._len_names[op.rel] = emit_len
+                rel_needed = False
             return VarExpandOp(
                 ctx, parent, self.current_graph, op.source, op.rel,
                 op.rel_types, op.target, op.target_labels, op.direction,
-                op.lower, op.upper, op.into, rel_needed=rel_needed)
+                op.lower, op.upper, op.into, rel_needed=rel_needed,
+                emit_len=emit_len)
         if isinstance(op, L.Filter):
-            return R.FilterOp(ctx, self.plan_op(op.parent), op.predicate)
+            parent = self.plan_op(op.parent)
+            return R.FilterOp(ctx, parent, self._fix(op.predicate))
         if isinstance(op, L.Project):
             parent = self.plan_op(op.parent)
             env = dict(op.fields)
-            items = [(name, expr, env[name]) for name, expr in op.items]
+            items = [(name, self._fix(expr), env[name])
+                     for name, expr in op.items]
             return R.ProjectOp(ctx, parent, items)
         if isinstance(op, L.Select):
             return R.SelectOp(ctx, self.plan_op(op.parent), op.names)
@@ -135,8 +228,8 @@ class RelationalPlanner:
         if isinstance(op, L.Aggregate):
             parent = self.plan_op(op.parent)
             env = dict(op.fields)
-            group = [(n, e, env[n]) for n, e in op.group]
-            aggs = [(n, a, env[n]) for n, a in op.aggregations]
+            group = [(n, self._fix(e), env[n]) for n, e in op.group]
+            aggs = [(n, self._fix(a), env[n]) for n, a in op.aggregations]
             default = R.AggregateOp(ctx, parent, group, aggs)
             from caps_tpu.relational.count_pattern import (
                 try_plan_count_pushdown,
@@ -144,14 +237,19 @@ class RelationalPlanner:
             pushed = try_plan_count_pushdown(self, op, default)
             return pushed if pushed is not None else default
         if isinstance(op, L.OrderBy):
-            return R.OrderByOp(ctx, self.plan_op(op.parent), op.items)
+            parent = self.plan_op(op.parent)
+            items = tuple((self._fix(e), asc) for e, asc in op.items)
+            return R.OrderByOp(ctx, parent, items)
         if isinstance(op, L.Skip):
-            return R.SkipOp(ctx, self.plan_op(op.parent), op.expr)
+            parent = self.plan_op(op.parent)
+            return R.SkipOp(ctx, parent, self._fix(op.expr))
         if isinstance(op, L.Limit):
-            return R.LimitOp(ctx, self.plan_op(op.parent), op.expr)
+            parent = self.plan_op(op.parent)
+            return R.LimitOp(ctx, parent, self._fix(op.expr))
         if isinstance(op, L.Unwind):
             env = dict(op.fields)
-            return R.UnwindOp(ctx, self.plan_op(op.parent), op.list_expr,
+            parent = self.plan_op(op.parent)
+            return R.UnwindOp(ctx, parent, self._fix(op.list_expr),
                               op.var, env[op.var])
         if isinstance(op, L.Optional):
             tagged, rhs, rid = self._plan_optional(op.lhs, op.rhs)

@@ -54,7 +54,7 @@ class VarExpandOp(RelationalOperator):
                  source: str, rel: str, rel_types: Tuple[str, ...],
                  target: str, target_labels, direction: Direction,
                  lower: int, upper: Opt[int], into: bool,
-                 rel_needed: bool = True):
+                 rel_needed: bool = True, emit_len: Opt[str] = None):
         super().__init__(context, [parent])
         self.graph = graph
         self.source = source
@@ -70,6 +70,9 @@ class VarExpandOp(RelationalOperator):
         # False = the planner proved no downstream operator reads the rel
         # variable, so per-path relationship lists need not materialize.
         self.rel_needed = rel_needed
+        # Set when the planner rewrote every size(rel)/length(rel) read
+        # to this path-length column (planner._collect_used_names).
+        self.emit_len = emit_len
         self.strategy = "join"
 
     # ------------------------------------------------------------------
@@ -133,6 +136,10 @@ class VarExpandOp(RelationalOperator):
         (parallel/ring.py make_ring_varexpand); single-chip it runs the
         same SpMV hops as one jitted program (the twin) — either way the
         join cascade and its per-hop materializations disappear."""
+        # ``into`` (both endpoints bound) stays on joins: measured at
+        # LDBC scale 11, the single-pair shape pays more in per-length
+        # explode/union dispatch than the tiny bound-pair joins cost
+        # (6.2 s vs 2.0 s p50 for IC13 on the CPU fallback).
         if self.rel_needed or self.into or self.upper > 3:
             return None
         backend = getattr(self.context.factory, "backend", None)
@@ -183,8 +190,9 @@ class VarExpandOp(RelationalOperator):
                     n_shards)
         seeds = np.unique(hsrc[hok])
         n_seeds = int(seeds.shape[0])
-        if n_seeds * n_pad > self._RING_MAX_MATRIX:
-            return None
+        if n_pad > self._RING_MAX_MATRIX:
+            return None  # a single frontier row exceeds the budget
+        # (large SEED sets are fine — the execution below chunks them)
         lengths = tuple(range(self.lower, self.upper + 1))
         self.strategy = ("ring-matrix"
                          if backend.mesh is not None
@@ -193,19 +201,23 @@ class VarExpandOp(RelationalOperator):
         rel_list_type = CTList(CTRelationship(self.rel_types))
 
         if n_seeds == 0:
-            pairs = DeviceTable(backend, {
+            cols0 = {
                 "__ring_src": Column("int", jnp.zeros(1, jnp.int64),
                                      jnp.zeros(1, bool), CTInteger),
                 "__ring_tgt": Column("int", jnp.zeros(1, jnp.int64),
                                      jnp.zeros(1, bool), CTInteger),
-            }, n=0)
+            }
+            if self.emit_len:
+                cols0[self.emit_len] = Column(
+                    "int", jnp.zeros(1, jnp.int64), jnp.zeros(1, bool),
+                    CTInteger)
+            pairs = DeviceTable(backend, cols0, n=0)
             return self._ring_assemble(parent_header, parent_table,
                                        src_id_col, tgt_header, tgt_table,
                                        tgt_id_col, pairs, rel_list_type)
 
-        # frontier seed-indicator matrix + target mask + padded edges
-        f0 = np.zeros((n_seeds, n_pad), dtype=np.int64)
-        f0[np.arange(n_seeds), seeds] = 1
+        # target mask + padded edges (seed-indicator frontiers are built
+        # per seed CHUNK below, so host memory stays bounded too)
         tmask = np.zeros(n_pad, dtype=np.int64)
         tmask[nids[nok]] = 1
         if self.direction == Direction.BOTH:
@@ -226,6 +238,12 @@ class VarExpandOp(RelationalOperator):
             return max(((length + n_shards - 1) // n_shards) * n_shards,
                        n_shards)
 
+        # compact to live entries: host mirrors are capacity-padded (the
+        # bucket, not the live row count), and dead rows would inflate
+        # every hop's gather width
+        live = np.asarray(ok_cat)
+        a, b = np.asarray(a)[live], np.asarray(b)[live]
+        ok_cat = np.ones(a.shape[0], dtype=bool)
         e_pad = shard_pad(a.shape[0])
         # peak working set is the per-hop (seeds, edges) gather — bound
         # it like the (seeds, nodes) frontier.  Only the 1-D ring path
@@ -237,7 +255,15 @@ class VarExpandOp(RelationalOperator):
                    and backend.mesh.devices.ndim == 1)
         widest = e_pad * 2 if self.upper == 3 else e_pad
         edges_per_device = widest // n_shards if on_ring else widest
-        if n_seeds * edges_per_device > self._RING_MAX_MATRIX:
+        # SEED BLOCKING: the per-hop working set is seeds x max(nodes,
+        # edges-per-device); larger seed sets run in fixed-size chunks
+        # (one compile, zero-padded last block) whose pair tables union.
+        per_seed = max(n_pad, edges_per_device)
+        if per_seed > self._RING_MAX_MATRIX:
+            return None  # even one seed's per-hop gather exceeds budget
+        chunk = max(1, min(n_seeds, self._RING_MAX_MATRIX // per_seed))
+        n_chunks = (n_seeds + chunk - 1) // chunk
+        if n_chunks > 64:  # degenerate shapes stay on the join path
             return None
         frm = np.zeros(e_pad, dtype=np.int32)
         to = np.zeros(e_pad, dtype=np.int32)
@@ -259,10 +285,8 @@ class VarExpandOp(RelationalOperator):
                 rid_cat = np.concatenate([rid_all, rid_all[nonloop]])
             else:
                 rid_cat = rid_all
-            keep = ok_cat
-            sp13, spt = build_iso3_sparse(
-                np.asarray(a)[keep], np.asarray(b)[keep], rid_cat[keep],
-                n_pad)
+            # a/b are already live-compacted; align rids with the same mask
+            sp13, spt = build_iso3_sparse(a, b, rid_cat[live], n_pad)
 
             def pad_sparse(tr):
                 s, d, w = tr
@@ -277,41 +301,72 @@ class VarExpandOp(RelationalOperator):
 
             s13s, s13d, s13w = pad_sparse(sp13)
             sts, std_, stw = pad_sparse(spt)
-            if on_ring:
-                fn = ring_varexpand3_cached(backend.mesh, n_pad, lengths,
-                                            backend.axis, correction)
-            else:
-                fn = ring_varexpand3_single(lengths, correction)
-            m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
-                   jnp.asarray(okp), jnp.asarray(tmask),
-                   jnp.asarray(s13s), jnp.asarray(s13d),
-                   jnp.asarray(s13w), jnp.asarray(sts),
-                   jnp.asarray(std_), jnp.asarray(stw))
+            extra3 = tuple(jnp.asarray(x)
+                           for x in (s13s, s13d, s13w, sts, std_, stw))
         else:
-            if on_ring:
-                fn = ring_varexpand_cached(backend.mesh, n_pad, lengths,
-                                           backend.axis, correction)
-            else:
-                # single chip, or a 2-D (DCN x ICI) mesh where the GSPMD
-                # partitioner schedules the collectives
-                fn = ring_varexpand_single(lengths, correction)
-            m = fn(jnp.asarray(f0), jnp.asarray(frm), jnp.asarray(to),
-                   jnp.asarray(okp), jnp.asarray(tmask))
-        counts = m.reshape(-1)
-        total = backend.consume_count(counts.sum())
-        out_cap = backend.bucket(total)
-        row, _within, valid, _tot = K.explode_expand(
-            counts, jnp.ones_like(counts, dtype=bool), out_cap)
-        s_idx = row // n_pad
-        v = row % n_pad
-        src_ids = jnp.asarray(seeds.astype(np.int64))[s_idx]
-        pairs = DeviceTable(backend, {
-            "__ring_src": Column("int", backend.place_rows(src_ids),
-                                 backend.place_rows(valid), CTInteger),
-            "__ring_tgt": Column("int",
-                                 backend.place_rows(v.astype(jnp.int64)),
-                                 backend.place_rows(valid), CTInteger),
-        }, n=total)
+            extra3 = ()
+
+        def run_chunk(f0_np, lens):
+            """One compiled program per distinct ``lens`` tuple."""
+            base = (jnp.asarray(f0_np), jnp.asarray(frm), jnp.asarray(to),
+                    jnp.asarray(okp), jnp.asarray(tmask))
+            if max(lens) == 3:
+                fn = (ring_varexpand3_cached(backend.mesh, n_pad, lens,
+                                             backend.axis, correction)
+                      if on_ring
+                      else ring_varexpand3_single(lens, correction))
+                return fn(*base, *extra3)
+            fn = (ring_varexpand_cached(backend.mesh, n_pad, lens,
+                                        backend.axis, correction)
+                  if on_ring
+                  # single chip, or a 2-D (DCN x ICI) mesh where the
+                  # GSPMD partitioner schedules the collectives
+                  else ring_varexpand_single(lens, correction))
+            return fn(*base)
+
+        # emit_len: one multiplicity matrix PER length with its length
+        # tagged on the rows; otherwise one matrix for the union
+        length_runs = ([(L, (L,)) for L in lengths] if self.emit_len
+                       else [(None, lengths)])
+        parts: List[Table] = []
+        for ci in range(n_chunks):
+            block = seeds[ci * chunk:(ci + 1) * chunk]
+            f0 = np.zeros((chunk, n_pad), dtype=np.int64)
+            f0[np.arange(block.shape[0]), block] = 1
+            for tag, lens in length_runs:
+                m = run_chunk(f0, lens)
+                counts = m.reshape(-1)
+                total = backend.consume_count(counts.sum())
+                out_cap = backend.bucket(total)
+                row, _within, valid, _tot = K.explode_expand(
+                    counts, jnp.ones_like(counts, dtype=bool), out_cap)
+                s_idx = row // n_pad
+                v = row % n_pad
+                block_pad = np.zeros(chunk, dtype=np.int64)
+                block_pad[:block.shape[0]] = block
+                src_ids = jnp.asarray(block_pad)[s_idx]
+                cols = {
+                    "__ring_src": Column(
+                        "int", backend.place_rows(src_ids),
+                        backend.place_rows(valid), CTInteger),
+                    "__ring_tgt": Column(
+                        "int", backend.place_rows(v.astype(jnp.int64)),
+                        backend.place_rows(valid), CTInteger),
+                }
+                if tag is not None:
+                    cols[self.emit_len] = Column(
+                        "int",
+                        backend.place_rows(jnp.full(out_cap, tag,
+                                                    jnp.int64)),
+                        backend.place_rows(valid), CTInteger)
+                parts.append(DeviceTable(backend, cols, n=total))
+        # balanced pairwise concat: incremental union over many chunk x
+        # length parts would re-copy the accumulated rows quadratically
+        while len(parts) > 1:
+            parts = [parts[i].union_all(parts[i + 1])
+                     if i + 1 < len(parts) else parts[i]
+                     for i in range(0, len(parts), 2)]
+        pairs = parts[0]
         return self._ring_assemble(parent_header, parent_table, src_id_col,
                                    tgt_header, tgt_table, tgt_id_col, pairs,
                                    rel_list_type)
@@ -320,7 +375,8 @@ class VarExpandOp(RelationalOperator):
                        tgt_header, tgt_table, tgt_id_col, pairs,
                        rel_list_type):
         """(source, target) multiplicity rows -> the join path's exact
-        output schema: parent columns + null rel-list + target columns."""
+        output schema: parent columns + null rel-list (+ path-length)
+        + target columns."""
         joined = parent_table.join(pairs, "inner",
                                    [(src_id_col, "__ring_src")])
         tt = tgt_table.rename({c: f"__t_{c}" for c in tgt_table.columns})
@@ -330,6 +386,10 @@ class VarExpandOp(RelationalOperator):
         joined = joined.with_literal_column(self.rel, None, rel_list_type)
         out_header = parent_header.with_expr(E.Var(self.rel), rel_list_type,
                                              column=self.rel)
+        if self.emit_len:
+            out_header = out_header.with_expr(E.Var(self.emit_len),
+                                              CTInteger,
+                                              column=self.emit_len)
         out_header = out_header.concat(tgt_header)
         return out_header, joined.select(list(out_header.columns))
 
@@ -352,6 +412,9 @@ class VarExpandOp(RelationalOperator):
             final_cols = list(parent_table.columns) + [self.rel] \
                 + list(tgt_header.columns)
 
+        if self.emit_len:
+            final_cols = final_cols + [self.emit_len]
+
         cur = "__vle_cur"
         frontier = parent_table.copy_column(src_id_col, cur)
         hop_id_cols: List[str] = []
@@ -361,6 +424,9 @@ class VarExpandOp(RelationalOperator):
             """Pack hop ids into the rel list column, join/filter target,
             project to the uniform final column set."""
             t = t.pack_list(hops, self.rel, rel_list_type)
+            if self.emit_len:
+                t = t.with_literal_column(self.emit_len, len(hops),
+                                          CTInteger)
             if self.into:
                 sh = synth_header(t)
                 t = t.filter(E.Equals(E.Var(cur), E.Var(tgt_id_col)), sh, params)
@@ -401,6 +467,10 @@ class VarExpandOp(RelationalOperator):
 
         out_header = parent_header.with_expr(E.Var(self.rel), rel_list_type,
                                              column=self.rel)
+        if self.emit_len:
+            out_header = out_header.with_expr(E.Var(self.emit_len),
+                                              CTInteger,
+                                              column=self.emit_len)
         if not self.into and tgt_header is not None:
             out_header = out_header.concat(tgt_header)
         return out_header, out.select(list(out_header.columns))

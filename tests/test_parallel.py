@@ -170,8 +170,12 @@ def test_varexpand_rides_ring_on_mesh():
          "ring-matrix"),
         ("MATCH (a:Person)-[*1..2]->(b) RETURN a.name AS a, b.name AS b",
          "ring-matrix"),
-        # rel var returned -> per-path data -> join path
+        # size(r)-only use is rewritten to a path-length column and
+        # stays on the matrix path
         ("MATCH (a)-[r:KNOWS*1..2]->(b) RETURN a.name AS a, size(r) AS n",
+         "ring-matrix"),
+        # rel var VALUE returned -> per-path data -> join path
+        ("MATCH (a)-[r:KNOWS*1..2]->(b) RETURN a.name AS a, r AS r",
          "join"),
         # undirected rides the ring too (symmetrized edges + degree
         # correction)
@@ -266,7 +270,8 @@ def test_varexpand_matrix_single_chip():
          "matrix"),
         ("MATCH (a)-[:KNOWS*1..2]-(b) RETURN a.name AS a, b.name AS b",
          "matrix"),
-        ("MATCH (a)-[r:KNOWS*1..2]->(b) RETURN size(r) AS n", "join"),
+        ("MATCH (a)-[r:KNOWS*1..2]->(b) RETURN size(r) AS n", "matrix"),
+        ("MATCH (a)-[r:KNOWS*1..2]->(b) RETURN r AS r", "join"),
     ]:
         res = gt.cypher(q)
         assert Bag(res.records.to_maps()) == \
@@ -402,3 +407,34 @@ def test_ring_varexpand3_kernel_vs_twin(mesh):
         tuple(jnp.asarray(x) for x in spt_p), correction="degree"))
     np.testing.assert_array_equal(got, want)
     assert got.sum() > 0
+
+
+def test_varexpand_matrix_seed_blocking(monkeypatch):
+    """Large seed sets run the matrix path in fixed-size chunks whose
+    pair tables union — forced here by shrinking the working-set cap —
+    with identical results and strategy."""
+    from caps_tpu.backends.local.session import LocalCypherSession
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.relational.var_expand import VarExpandOp
+    from caps_tpu.testing.bag import Bag
+    from caps_tpu.testing.factory import create_graph
+
+    rng = np.random.RandomState(3)
+    n = 9
+    parts = [f"(n{i}:P {{v: {i}}})" for i in range(n)]
+    edges = [f"(n{rng.randint(0, n)})-[:K]->(n{rng.randint(0, n)})"
+             for _ in range(18)]
+    create = "CREATE " + ", ".join(parts + edges)
+    q = "MATCH (a)-[:K*1..2]-(b) RETURN a.v AS a, b.v AS b"
+    want = create_graph(LocalCypherSession(), create, {}
+                        ).cypher(q).records.to_maps()
+
+    # force chunking: the per-seed cost is ~bucket-capacity (256-padded
+    # edge list), so a ~3-seed budget splits the 9 seeds into chunks
+    monkeypatch.setattr(VarExpandOp, "_RING_MAX_MATRIX", 2000)
+    tpu = TPUCypherSession()
+    res = create_graph(tpu, create, {}).cypher(q)
+    assert Bag(res.records.to_maps()) == Bag(want)
+    ve = [m for m in res.metrics["operators"] if m["op"] == "VarExpand"]
+    assert ve and ve[0]["strategy"] == "matrix", ve
+    assert tpu.fallback_count == 0
