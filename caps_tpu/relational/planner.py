@@ -47,15 +47,30 @@ class RelationalPlanner:
         # min(size(r))).  _fix() rewrites those reads in consumers.
         self._size_only_ok: frozenset = frozenset()
         self._len_names: Dict[str, str] = {}
+        # single-hop rel var -> its pattern endpoints (for the
+        # startNode()/endNode() property rewrite in _fix)
+        self._rel_endpoints: Dict[str, Tuple[str, str]] = {}
 
     def fresh(self, prefix: str) -> str:
         self._fresh += 1
         return f"__{prefix}_{self._fresh}"
 
-    def _fix(self, e: E.Expr) -> E.Expr:
-        """Rewrite size(rel)/length(rel) reads of a size-only var-length
-        rel variable to its path-length column (see _len_names)."""
-        if not self._len_names:
+    def _fix(self, e: E.Expr, scope: Opt[L.LogicalOperator] = None
+             ) -> E.Expr:
+        """Expression rewrites that need plan context:
+
+        * size(rel)/length(rel) of a size-only var-length rel variable
+          → its path-length column (see _len_names);
+        * startNode(rel).k / endNode(rel).k where the MATCH bound the
+          endpoints → CASE WHEN startNode(rel) = id(x) THEN x.k ELSE
+          y.k — correct for every match direction, because startNode/
+          endNode follow the STORED orientation and the comparison is
+          against the actual stored id (previously these silently
+          evaluated the property of a bare node id: null).  Applied only
+          when ``scope`` (the consumer's input subtree) still carries
+          the pattern's endpoint bindings unobscured — see
+          _endpoints_reach."""
+        if not self._len_names and not self._rel_endpoints:
             return e
 
         def repl(x):
@@ -64,12 +79,73 @@ class RelationalPlanner:
                     and len(x.args) == 1 and isinstance(x.args[0], E.Var)
                     and x.args[0].name in self._len_names):
                 return E.Var(self._len_names[x.args[0].name])
+            if (isinstance(x, E.Property)
+                    and isinstance(x.entity, (E.StartNode, E.EndNode))
+                    and isinstance(x.entity.rel, E.Var)
+                    and x.entity.rel.name in self._rel_endpoints
+                    and scope is not None):
+                a, b = self._rel_endpoints[x.entity.rel.name]
+                if self._endpoints_reach(scope, x.entity.rel.name, a, b):
+                    return E.CaseExpr(
+                        (E.Equals(x.entity, E.Id(E.Var(a))),),
+                        (E.Property(E.Var(a), x.key),),
+                        E.Property(E.Var(b), x.key))
             return x
 
         return e.transform_up(repl)
 
+    def _endpoints_reach(self, op, rel: str, a: str, b: str) -> bool:
+        """True when, walking down the consumer's input subtree, the
+        Expand binding ``rel`` is reached with its endpoint names
+        ``a``/``b`` neither dropped by a Select nor rebound by a
+        Project/Aggregate/Unwind/var-length bind along the way."""
+        while op is not None:
+            if isinstance(op, L.Select):
+                if not {a, b} <= set(op.names):
+                    return False
+                op = op.parent
+            elif isinstance(op, L.Project):
+                if {a, b} & {n for n, _ in op.items}:
+                    return False
+                op = op.parent
+            elif isinstance(op, L.Aggregate):
+                return False  # only grouped aliases survive
+            elif isinstance(op, L.Unwind):
+                if op.var in (a, b):
+                    return False
+                op = op.parent
+            elif isinstance(op, L.Expand):
+                if op.rel == rel:
+                    return {op.source, op.target} == {a, b}
+                if op.target in (a, b) and op.rel != rel:
+                    # a different hop also binds this name; identity of
+                    # the binding still holds (same row value), continue
+                    pass
+                op = op.parent
+            elif isinstance(op, L.BoundedVarLengthExpand):
+                if op.rel == rel or op.target in (a, b) \
+                        or op.rel in (a, b):
+                    return False
+                op = op.parent
+            elif isinstance(op, (L.Filter, L.Distinct, L.OrderBy, L.Skip,
+                                 L.Limit, L.NodeScan, L.FromGraph)):
+                op = getattr(op, "parent", None)
+            elif isinstance(op, (L.Optional, L.ExistsSemiJoin)):
+                return (self._endpoints_reach(op.rhs, rel, a, b)
+                        or self._endpoints_reach(op.lhs, rel, a, b))
+            elif isinstance(op, (L.CartesianProduct, L.ValueJoin)):
+                return (self._endpoints_reach(op.lhs, rel, a, b)
+                        or self._endpoints_reach(op.rhs, rel, a, b))
+            elif isinstance(op, L.TabularUnionAll):
+                # rows come from either branch: both must satisfy
+                return (self._endpoints_reach(op.lhs, rel, a, b)
+                        and self._endpoints_reach(op.rhs, rel, a, b))
+            else:
+                return False  # unknown operator: conservative
+        return False
+
     def process(self, plan: L.LogicalPlan) -> R.RelationalOperator:
-        self._used_names, self._size_only_ok = \
+        self._used_names, self._size_only_ok, self._rel_endpoints = \
             self._collect_used_names(plan.root)
         return self.plan_op(plan.root)
 
@@ -108,6 +184,8 @@ class RelationalPlanner:
         wrapped: dict = {}
         varlen_binds: dict = {}
         other_binds = set()
+        rel_endpoints: dict = {}
+        shadowed = set()
         conservative = False
         has_exists = False
 
@@ -147,6 +225,9 @@ class RelationalPlanner:
                 other_binds.add(op.var)
             elif isinstance(op, L.Expand):
                 other_binds.update((op.rel, op.target))
+                if op.rel in rel_endpoints:
+                    shadowed.add(op.rel)  # rebound: ambiguous endpoints
+                rel_endpoints[op.rel] = (op.source, op.target)
             elif isinstance(op, L.Unwind):
                 other_binds.add(op.var)
             elif isinstance(op, L.Project):
@@ -162,15 +243,18 @@ class RelationalPlanner:
                     walk(c)
 
         walk(root)
+        for n in shadowed:
+            rel_endpoints.pop(n, None)
+
         if conservative:
-            return None, frozenset()
+            return None, frozenset(), {}
         if has_exists:
-            return frozenset(used), frozenset()
+            return frozenset(used), frozenset(), rel_endpoints
         size_only = frozenset(
             n for n, t in total.items()
             if wrapped.get(n, 0) == t and n not in selected
             and varlen_binds.get(n, 0) == 1 and n not in other_binds)
-        return frozenset(used), size_only
+        return frozenset(used), size_only, rel_endpoints
 
     # ------------------------------------------------------------------
 
@@ -214,11 +298,12 @@ class RelationalPlanner:
                 emit_len=emit_len)
         if isinstance(op, L.Filter):
             parent = self.plan_op(op.parent)
-            return R.FilterOp(ctx, parent, self._fix(op.predicate))
+            return R.FilterOp(ctx, parent,
+                               self._fix(op.predicate, op.parent))
         if isinstance(op, L.Project):
             parent = self.plan_op(op.parent)
             env = dict(op.fields)
-            items = [(name, self._fix(expr), env[name])
+            items = [(name, self._fix(expr, op.parent), env[name])
                      for name, expr in op.items]
             return R.ProjectOp(ctx, parent, items)
         if isinstance(op, L.Select):
@@ -228,8 +313,10 @@ class RelationalPlanner:
         if isinstance(op, L.Aggregate):
             parent = self.plan_op(op.parent)
             env = dict(op.fields)
-            group = [(n, self._fix(e), env[n]) for n, e in op.group]
-            aggs = [(n, self._fix(a), env[n]) for n, a in op.aggregations]
+            group = [(n, self._fix(e, op.parent), env[n])
+                     for n, e in op.group]
+            aggs = [(n, self._fix(a, op.parent), env[n])
+                    for n, a in op.aggregations]
             default = R.AggregateOp(ctx, parent, group, aggs)
             from caps_tpu.relational.count_pattern import (
                 try_plan_count_pushdown,
@@ -238,18 +325,20 @@ class RelationalPlanner:
             return pushed if pushed is not None else default
         if isinstance(op, L.OrderBy):
             parent = self.plan_op(op.parent)
-            items = tuple((self._fix(e), asc) for e, asc in op.items)
+            items = tuple((self._fix(e, op.parent), asc)
+                          for e, asc in op.items)
             return R.OrderByOp(ctx, parent, items)
         if isinstance(op, L.Skip):
             parent = self.plan_op(op.parent)
-            return R.SkipOp(ctx, parent, self._fix(op.expr))
+            return R.SkipOp(ctx, parent, self._fix(op.expr, op.parent))
         if isinstance(op, L.Limit):
             parent = self.plan_op(op.parent)
-            return R.LimitOp(ctx, parent, self._fix(op.expr))
+            return R.LimitOp(ctx, parent, self._fix(op.expr, op.parent))
         if isinstance(op, L.Unwind):
             env = dict(op.fields)
             parent = self.plan_op(op.parent)
-            return R.UnwindOp(ctx, parent, self._fix(op.list_expr),
+            return R.UnwindOp(ctx, parent,
+                              self._fix(op.list_expr, op.parent),
                               op.var, env[op.var])
         if isinstance(op, L.Optional):
             tagged, rhs, rid = self._plan_optional(op.lhs, op.rhs)

@@ -306,10 +306,13 @@ class VarExpandOp(RelationalOperator):
         else:
             extra3 = ()
 
+        # constants uploaded ONCE; only the frontier block varies per call
+        frm_d, to_d, okp_d, tmask_d = (jnp.asarray(frm), jnp.asarray(to),
+                                       jnp.asarray(okp), jnp.asarray(tmask))
+
         def run_chunk(f0_np, lens):
             """One compiled program per distinct ``lens`` tuple."""
-            base = (jnp.asarray(f0_np), jnp.asarray(frm), jnp.asarray(to),
-                    jnp.asarray(okp), jnp.asarray(tmask))
+            base = (jnp.asarray(f0_np), frm_d, to_d, okp_d, tmask_d)
             if max(lens) == 3:
                 fn = (ring_varexpand3_cached(backend.mesh, n_pad, lens,
                                              backend.axis, correction)

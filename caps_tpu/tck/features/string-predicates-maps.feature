@@ -119,3 +119,35 @@ Feature: String predicates, regex, maps and keys
       | 'A'     | 1 |
       | 'none'  | 1 |
       | 'other' | 1 |
+
+  Scenario: startNode and endNode property access follows stored orientation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {v: 1})-[:K]->(b:P {v: 2}), (b)-[:K]->(c:P {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (x)-[r:K]->(y)
+      RETURN startNode(r).v AS s, endNode(r).v AS e
+      """
+    Then the result should be, in any order:
+      | s | e |
+      | 1 | 2 |
+      | 2 | 3 |
+
+  Scenario: startNode property under an undirected match is the stored source
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {v: 1})-[:K]->(b:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (x)-[r:K]-(y)
+      RETURN x.v AS x, startNode(r).v AS s, endNode(r).v AS e
+      """
+    Then the result should be, in any order:
+      | x | s | e |
+      | 1 | 1 | 2 |
+      | 2 | 1 | 2 |

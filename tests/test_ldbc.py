@@ -209,10 +209,8 @@ def test_ic13_vs_numpy(graphs):
 def test_sharded_parity_smoke():
     """A slice of the LDBC reads on the 8-device mesh: the distributed
     engine answers the same rows as the oracle (configs 2/3 sharded)."""
-    sharded = TPUCypherSession(
-        config=__import__("caps_tpu.okapi.config",
-                          fromlist=["EngineConfig"]).EngineConfig(
-            mesh_shape=(8,)))
+    from caps_tpu.okapi.config import EngineConfig
+    sharded = TPUCypherSession(config=EngineConfig(mesh_shape=(8,)))
     glocal, d = ldbc.build_graph(LocalCypherSession(), SCALE, SEED)
     gs, _ = ldbc.build_graph(sharded, SCALE, SEED)
     rng = np.random.RandomState(41)
