@@ -105,22 +105,18 @@ class RelationalPlanner:
                     return False
                 op = op.parent
             elif isinstance(op, L.Project):
-                if {a, b} & {n for n, _ in op.items}:
-                    return False
+                if {rel, a, b} & {n for n, _ in op.items}:
+                    return False  # rel or endpoint rebound here
                 op = op.parent
             elif isinstance(op, L.Aggregate):
                 return False  # only grouped aliases survive
             elif isinstance(op, L.Unwind):
-                if op.var in (a, b):
+                if op.var in (rel, a, b):
                     return False
                 op = op.parent
             elif isinstance(op, L.Expand):
                 if op.rel == rel:
                     return {op.source, op.target} == {a, b}
-                if op.target in (a, b) and op.rel != rel:
-                    # a different hop also binds this name; identity of
-                    # the binding still holds (same row value), continue
-                    pass
                 op = op.parent
             elif isinstance(op, L.BoundedVarLengthExpand):
                 if op.rel == rel or op.target in (a, b) \
@@ -206,8 +202,15 @@ class RelationalPlanner:
                 if isinstance(c, E.Expr):
                     count_expr(c)
 
+        seen_ops = set()
+
         def walk(op):
             nonlocal conservative
+            # shared subtrees (Optional/ExistsSemiJoin rhs embeds lhs)
+            # must count once, or a single Expand looks rebound
+            if id(op) in seen_ops:
+                return
+            seen_ops.add(id(op))
             if isinstance(op, (L.ConstructGraph, L.ReturnGraph)):
                 conservative = True
             if isinstance(op, L.Select):
@@ -225,7 +228,8 @@ class RelationalPlanner:
                 other_binds.add(op.var)
             elif isinstance(op, L.Expand):
                 other_binds.update((op.rel, op.target))
-                if op.rel in rel_endpoints:
+                if op.rel in rel_endpoints and \
+                        rel_endpoints[op.rel] != (op.source, op.target):
                     shadowed.add(op.rel)  # rebound: ambiguous endpoints
                 rel_endpoints[op.rel] = (op.source, op.target)
             elif isinstance(op, L.Unwind):

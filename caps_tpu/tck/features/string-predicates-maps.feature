@@ -151,3 +151,49 @@ Feature: String predicates, regex, maps and keys
       | x | s | e |
       | 1 | 1 | 2 |
       | 2 | 1 | 2 |
+
+  Scenario: labels type and id functions
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:Person:Admin {v: 1})-[:KNOWS]->(b:Person {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:Admin)-[r]->(m)
+      RETURN labels(n) AS ln, type(r) AS t, labels(m) AS lm,
+             id(n) = id(m) AS same
+      """
+    Then the result should be, in any order:
+      | ln                  | t       | lm         | same  |
+      | ['Admin', 'Person'] | 'KNOWS' | ['Person'] | false |
+
+  Scenario: coalesce picks the first non-null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE ({a: 1}), ({b: 2}), ({c: 3})
+      """
+    When executing query:
+      """
+      MATCH (n) RETURN coalesce(n.a, n.b, 99) AS v
+      """
+    Then the result should be, in any order:
+      | v  |
+      | 1  |
+      | 2  |
+      | 99 |
+
+  Scenario: label predicate in WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {v: 1}), (:A {v: 2}), (:B {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n) WHERE n:A AND NOT n:B RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 2 |
