@@ -354,6 +354,18 @@ def main():
     rtt_floor = measure_rtt_floor()
     med, done = time_fn(lambda: run_query(graph), iters=iters)
     per_query = work / med
+    # Roofline column (round-4 VERDICT item 2): bytes the operators pull
+    # through memory per query and the achieved bandwidth vs the chip's
+    # HBM peak (v5e ~819 GB/s) — the utilization number that makes
+    # kernel-quality regressions visible behind transport noise.
+    bytes_touched = graph.cypher(QUERY).metrics.get("bytes_touched", 0)
+    achieved_gbps = bytes_touched / med / 1e9 if med else 0.0
+    HBM_PEAK_GBPS = 819.0  # v5e HBM bandwidth
+    _result.update({
+        "bytes_touched": int(bytes_touched),
+        "achieved_gbps": round(achieved_gbps, 3),
+        "hbm_frac": round(achieved_gbps / HBM_PEAK_GBPS, 5),
+    })
     # Pipelined throughput: each query fully executes on device; results
     # are read back in one batched transfer (the per-read round trip —
     # rtt_floor_s — dominates sequential mode on remote transports).

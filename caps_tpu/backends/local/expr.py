@@ -268,6 +268,11 @@ class _Evaluator:
 
         if isinstance(e, E.FunctionExpr):
             return self._function(e)
+        if isinstance(e, E.PathExpr):
+            raise ExprEvalError(
+                "path values can only be returned, compared with =/<>, or "
+                "passed to length()/nodes()/relationships()/count(); this "
+                "expression uses a path variable in an unsupported position")
         if isinstance(e, E.Aggregator):
             raise ExprEvalError(
                 f"aggregator {e!r} outside aggregation context")

@@ -34,13 +34,27 @@ class TPUCypherSession(RelationalCypherSession):
     def _cypher_on_graph(self, graph, query, parameters=None):
         """Route every query through the fused executor: first run records
         the data-dependent sizes, repeats replay them with zero host syncs
-        (backends/tpu/fused.py — the whole-stage-codegen analog)."""
+        (backends/tpu/fused.py — the whole-stage-codegen analog).  Attaches
+        the backend's communication accounting (ICI bytes shuffled by the
+        hand-scheduled joins, strategy counts — SURVEY.md §5.5) to the
+        result's metrics as per-query deltas."""
+        be = self.backend
+        before = (be.ici_bytes, be.dist_joins, be.broadcast_joins,
+                  be.fallbacks, be.syncs)
         if not self.config.use_fused:
-            return super()._cypher_on_graph(graph, query, parameters)
-        key = self.fused.key(graph, query, dict(parameters or {}))
-        return self.fused.run(
-            key, lambda: super(TPUCypherSession, self)._cypher_on_graph(
-                graph, query, parameters))
+            result = super()._cypher_on_graph(graph, query, parameters)
+        else:
+            key = self.fused.key(graph, query, dict(parameters or {}))
+            result = self.fused.run(
+                key, lambda: super(TPUCypherSession, self)._cypher_on_graph(
+                    graph, query, parameters))
+        if result.metrics is not None:
+            result.metrics["ici_bytes"] = be.ici_bytes - before[0]
+            result.metrics["dist_joins"] = be.dist_joins - before[1]
+            result.metrics["broadcast_joins"] = be.broadcast_joins - before[2]
+            result.metrics["device_fallbacks"] = be.fallbacks - before[3]
+            result.metrics["size_syncs"] = be.syncs - before[4]
+        return result
 
     @property
     def fallback_count(self) -> int:

@@ -6,8 +6,11 @@ SURVEY.md §2): filter = mask + compact, join = sort-merge + segmented
 expansion, aggregate = sort + segment reductions, orderBy = multi-key
 lexicographic lax.sort — all shape-static and jit-cached per bucket.
 
-Collect aggregation runs on-device (sorted segment gather); the remaining
-operators without a device path (DISTINCT aggregates, some
+Collect and DISTINCT aggregation run on-device (sorted segment gather; an
+extra stable sort per distinct column marks first occurrences — see
+``_group_device``); the full LDBC read suite executes with zero host
+fallbacks (``tests/test_ldbc.py::test_no_device_fallbacks``).  The
+remaining operators without a device path (percentile DISTINCT, some
 collection-valued expressions, …) raise :class:`UnsupportedOnDevice`; the
 table then converts to the local oracle backend and continues there.
 Fallbacks are counted on the backend object so benchmarks can assert the
@@ -80,6 +83,13 @@ class DeviceBackend:
         self.fallbacks = 0
         self.fallback_reasons: List[str] = []
         self.syncs = 0  # device->host scalar materializations (perf metric)
+        # Distributed-join accounting (SURVEY.md §5.5/§5.8): bytes moved
+        # over ICI by hand-scheduled collectives (static shape estimates:
+        # each exchanged/gathered buffer counted once per hop it crosses),
+        # and how often each strategy fired.
+        self.ici_bytes = 0
+        self.dist_joins = 0       # radix exchange joins executed
+        self.broadcast_joins = 0  # all_gather broadcast joins executed
         # Size-sync routing for the fused executor (backends/tpu/fused.py):
         # None = eager (device->host sync per data-dependent size);
         # ("record", sizes)       = eager + record every size in order;
@@ -226,6 +236,20 @@ class DeviceTable(Table):
         if self._local is not None:
             return self._local.column_type(col)
         return self._cols[col].ctype
+
+    @property
+    def nbytes(self) -> int:
+        """Exact device-buffer bytes of the columns (data + validity +
+        list lengths), padding included — what an operator reading this
+        table pulls through HBM."""
+        if self._local is not None:
+            return self._local.nbytes
+        total = 0
+        for col in self._cols.values():
+            total += col.data.nbytes + col.valid.nbytes
+            if col.lens is not None:
+                total += col.lens.nbytes
+        return total
 
     # -- column ops ------------------------------------------------------
 
@@ -377,20 +401,35 @@ class DeviceTable(Table):
             return cached[1]
         return None
 
+    def _masked_left_key(self, lcol: Column) -> jnp.ndarray:
+        """Probe key with null values folded to the never-matching
+        sentinel.  Liveness (row_ok) stays separate from key validity so
+        LEFT joins retain null-key rows (SQL/openCypher: an unmatched —
+        including null-keyed — left row survives null-extended)."""
+        return jnp.where(lcol.valid, self._join_key(lcol), K._L_NULL)
+
     def _sort_merge_join(self, other: "DeviceTable", how: str,
                          pairs: Sequence[Tuple[str, str]]) -> "DeviceTable":
         lc, rc = pairs[0]
         lcol, rcol = self._cols[lc], other._cols[rc]
-        l_ok = lcol.valid & self.row_ok
+        l_ok = self.row_ok
         left_join = how == "left"
         csr = self._csr_for(other, rcol)
+        if csr is None:
+            # No resident adjacency to probe: on a 1-D mesh, schedule the
+            # collectives by hand (radix exchange / broadcast join) instead
+            # of leaving the layout to GSPMD (parallel/dist_join.py).
+            dist = self._dist_join(other, how, pairs)
+            if dist is not None:
+                return dist
         if csr is not None:
             # CSR probe: two indptr gathers per row, no sort, no search
-            counts, lo = csr.probe(self._join_key(lcol), l_ok)
+            counts, lo = csr.probe(self._masked_left_key(lcol), l_ok)
             perm = csr.perm
         else:
             rk_sorted, perm = self._cached_right_sort(other, rcol)
-            counts, lo = K.probe_count(self._join_key(lcol), l_ok, rk_sorted)
+            counts, lo = K.probe_count(self._masked_left_key(lcol), l_ok,
+                                       rk_sorted)
         total = self.backend.consume_count(K.join_total(counts, l_ok, left_join))
         out_cap = self.backend.bucket(total)
         if self.backend.config.use_pallas and OPS.pallas_usable("prefetch"):
@@ -408,7 +447,13 @@ class DeviceTable(Table):
             out_cols[c] = Column(col.kind, col.data, col.valid & r_matched,
                                  col.ctype, col.lens)
         out = DeviceTable(self.backend, out_cols, total)
-        # Extra equality pairs: post-filter (first pair drove the merge).
+        return out._extra_pair_filter(pairs, left_join)
+
+    def _extra_pair_filter(self, pairs: Sequence[Tuple[str, str]],
+                           left_join: bool) -> "DeviceTable":
+        """Extra equality pairs: post-filter (the first pair drove the
+        merge)."""
+        out = self
         for lc2, rc2 in pairs[1:]:
             a, b = out._cols[lc2], out._cols[rc2]
             if a.kind == "float" or b.kind == "float":
@@ -425,6 +470,114 @@ class DeviceTable(Table):
                 keep = eq
             out = out._compact(keep & out.row_ok)
         return out
+
+    def _dist_join(self, other: "DeviceTable", how: str,
+                   pairs: Sequence[Tuple[str, str]]
+                   ) -> Optional["DeviceTable"]:
+        """Hand-scheduled distributed join over a 1-D mesh
+        (parallel/dist_join.py): broadcast join for small build sides,
+        all_to_all radix exchange (with optional hot-key salting)
+        otherwise.  Returns None when the shape/config rules it out —
+        the caller then stays on the single-program GSPMD path."""
+        be = self.backend
+        cfg = be.config
+        if (be.mesh is None or not cfg.use_dist_join
+                or len(be.mesh.axis_names) != 1
+                or how not in ("inner", "left")):
+            return None
+        n = be.n_shards
+        if n <= 1 or self.capacity % n or other.capacity % n:
+            return None
+        for col in list(self._cols.values()) + list(other._cols.values()):
+            if col.lens is not None:
+                return None  # list columns: leave to the GSPMD path
+        lc, rc = pairs[0]
+        lcol, rcol = self._cols[lc], other._cols[rc]
+        try:
+            # null keys fold to the sentinel; liveness stays separate so
+            # LEFT joins retain null-key rows (see _masked_left_key)
+            l_key = jnp.where(lcol.valid, self._join_key(lcol, side="l"),
+                              K._L_NULL)
+            r_key = self._join_key(rcol, side="r")
+        except UnsupportedOnDevice:
+            return None
+        from caps_tpu.parallel import dist_join as DJ
+        l_ok = self.row_ok
+        r_ok = rcol.valid & other.row_ok
+        left_join = how == "left"
+        l_names, r_names = list(self._cols), list(other._cols)
+        l_arrs = [a for c in l_names
+                  for a in (self._cols[c].data, self._cols[c].valid)]
+        r_arrs = [a for c in r_names
+                  for a in (other._cols[c].data, other._cols[c].valid)]
+        n_l, n_r = len(l_arrs), len(r_arrs)
+
+        KEY_OK_BYTES = 9  # int64 key + bool validity channel
+
+        def row_bytes(arrs) -> int:
+            return sum(a.dtype.itemsize for a in arrs) + KEY_OK_BYTES
+
+        if other._n <= cfg.broadcast_join_threshold:
+            prog1 = DJ.make_broadcast_join(be.mesh, be.axis, n_l, n_r,
+                                           1, left_join, True)
+            (max_total,) = prog1(l_key, l_ok, r_key, r_ok, *l_arrs, *r_arrs)
+            out_cap_dev = be.bucket(max(1, be.consume_count(max_total)))
+            prog2 = DJ.make_broadcast_join(be.mesh, be.axis, n_l, n_r,
+                                           out_cap_dev, left_join, False)
+            res = prog2(l_key, l_ok, r_key, r_ok, *l_arrs, *r_arrs)
+            # each device receives the other (n-1) shards of the build
+            # side; the count phase gathers only key+ok, the expand phase
+            # the full payload
+            be.ici_bytes += (KEY_OK_BYTES + row_bytes(r_arrs)) \
+                * other.capacity * (n - 1)
+            be.broadcast_joins += 1
+        else:
+            salt = max(1, min(cfg.join_salt, n))
+            local_cap = max(self.capacity, other.capacity) // n
+            bin_cap = min(local_cap, max(8, -(-local_cap * 2 // n)))
+            while True:
+                prog1 = DJ.make_radix_join_phase1(
+                    be.mesh, be.axis, n, n_l, n_r,
+                    tuple(str(a.dtype) for a in l_arrs),
+                    tuple(str(a.dtype) for a in r_arrs), bin_cap, salt)
+                outs = prog1(l_key, l_ok, r_key, r_ok, *l_arrs, *r_arrs)
+                (lok_r, counts, lo, perm, rok_r,
+                 max_total, max_left, dropped) = outs[:8]
+                payload = outs[8:]
+                # of each device's n bins, n-1 cross ICI (bin i stays home
+                # on device i)
+                be.ici_bytes += (row_bytes(l_arrs) + row_bytes(r_arrs) * salt
+                                 ) * n * (n - 1) * bin_cap
+                if be.consume_count(dropped) == 0:
+                    break
+                if bin_cap >= local_cap:
+                    return None  # safe bound exceeded: should not happen
+                bin_cap = min(local_cap, bin_cap * 2)
+            total_dev = be.consume_count(max_left if left_join else max_total)
+            out_cap_dev = be.bucket(max(1, total_dev))
+            prog2 = DJ.make_radix_join_phase2(be.mesh, be.axis, n_l, n_r,
+                                              out_cap_dev, left_join)
+            res = prog2(lok_r, counts, lo, perm, rok_r, *payload)
+            be.dist_joins += 1
+
+        l_valid, r_valid = res[0], res[1]
+        datas = res[2:]
+        out_cols: Dict[str, Column] = {}
+        i = 0
+        for c in l_names:
+            col = self._cols[c]
+            out_cols[c] = Column(col.kind, datas[i], datas[i + 1] & l_valid,
+                                 col.ctype)
+            i += 2
+        for c in r_names:
+            col = other._cols[c]
+            out_cols[c] = Column(col.kind, datas[i], datas[i + 1] & r_valid,
+                                 col.ctype)
+            i += 2
+        cap_out = int(l_valid.shape[0])
+        tmp = DeviceTable(be, out_cols, cap_out)  # rows valid where l_valid
+        out = tmp._compact(l_valid)
+        return out._extra_pair_filter(pairs, left_join)
 
     def _cross_join(self, other: "DeviceTable") -> "DeviceTable":
         total = self._n * other._n

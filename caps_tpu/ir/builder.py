@@ -33,7 +33,7 @@ from caps_tpu.ir.typer import SchemaTyper
 from caps_tpu.okapi.graph import QualifiedGraphName
 from caps_tpu.okapi.schema import Schema
 from caps_tpu.okapi.types import (
-    CTAny, CTList, CTNode, CTRelationship, CypherType, _CTList,
+    CTAny, CTList, CTNode, CTPath, CTRelationship, CypherType, _CTList,
 )
 
 SchemaResolver = Callable[[QualifiedGraphName], Schema]
@@ -85,12 +85,26 @@ class IRBuilder:
         return CypherQuery(tuple(b.blocks))
 
 
+@dataclasses.dataclass(frozen=True)
+class _PathDef:
+    """Scope record for a named path: constituent vars while the defining
+    MATCH's bindings are live (``projected=False``), or just the segment
+    shape once the path has been reified through a WITH/RETURN
+    (``projected=True`` — reads then resolve to PathSeg/PathNode header
+    columns)."""
+    node_vars: Tuple[str, ...]
+    rel_vars: Tuple[str, ...]
+    varlen: Tuple[bool, ...]
+    projected: bool = False
+
+
 class _SingleQueryBuilder:
     def __init__(self, parent: IRBuilder):
         self.parent = parent
         self.schema = parent.ambient_schema
         self.typer = SchemaTyper(self.schema, parent.parameters)
         self.env: Dict[str, CypherType] = {}
+        self.path_defs: Dict[str, _PathDef] = {}
         self.blocks: List[Block] = []
         self._anon = 0
 
@@ -137,7 +151,7 @@ class _SingleQueryBuilder:
                             predicates)
         if clause.where is not None:
             predicates.extend(self._split_ands(clause.where))
-        predicates = [self._resolve_exists(p) for p in predicates]
+        predicates = [self._resolve(p) for p in predicates]
         self.blocks.append(MatchBlock(
             Pattern(tuple(entities), tuple(connections), tuple(bound)),
             tuple(predicates), clause.optional))
@@ -150,6 +164,10 @@ class _SingleQueryBuilder:
 
         def declare_node(n: ast.NodePattern) -> str:
             name = n.var or self.fresh("node")
+            if name in self.path_defs:
+                raise IRBuildError(
+                    f"variable `{name}` is already declared as a path and "
+                    "cannot be reused as a node")
             if name in self.env:
                 if name not in bound:
                     bound.append(name)
@@ -163,10 +181,15 @@ class _SingleQueryBuilder:
             return name
 
         for part in pattern.parts:
-            if part.path_var is not None:
-                raise IRBuildError("named paths are not supported yet")
+            if part.path_var is not None and part.path_var in self.env:
+                raise IRBuildError(
+                    f"path variable `{part.path_var}` already bound")
+            path_nodes: List[str] = []
+            path_rels: List[str] = []
+            path_varlen: List[bool] = []
             elems = part.elements
             prev = declare_node(elems[0])
+            path_nodes.append(prev)
             i = 1
             while i < len(elems):
                 rel: ast.RelPattern = elems[i]
@@ -195,8 +218,15 @@ class _SingleQueryBuilder:
                     connections.append(Connection(
                         prev, rname, nxt, direction,
                         rel.rel_types, rel.var_length))
+                path_nodes.append(nxt)
+                path_rels.append(rname)
+                path_varlen.append(rel.var_length is not None)
                 prev = nxt
                 i += 2
+            if part.path_var is not None:
+                self.env[part.path_var] = CTPath
+                self.path_defs[part.path_var] = _PathDef(
+                    tuple(path_nodes), tuple(path_rels), tuple(path_varlen))
 
     # -- EXISTS subqueries ---------------------------------------------------
 
@@ -232,6 +262,128 @@ class _SingleQueryBuilder:
         finally:
             self.env = saved_env
 
+    # -- named paths ---------------------------------------------------------
+
+    def _path_rel_piece(self, d: _PathDef, name: str, i: int) -> E.Expr:
+        if d.projected:
+            return E.PathSeg(E.Var(name), i, d.varlen[i])
+        return E.Var(d.rel_vars[i])
+
+    def _resolve_paths(self, expr: E.Expr) -> E.Expr:
+        """Rewrite reads of named-path variables into expressions over the
+        path's constituent vars (fresh scope) or its PathSeg/PathNode
+        header columns (after a projection reified the path):
+
+          * ``length(p)`` → fixed hop count (+ ``size(<rel list>)`` per
+            var-length segment);
+          * ``relationships(p)`` → list concat of the hop rels;
+          * ``nodes(p)`` → list of the node vars (fixed-length paths);
+          * any other bare ``Var(p)`` in a fresh scope → ``PathExpr``
+            (only ProjectOp consumes it; see relational/ops.py).
+        """
+        if not self.path_defs:
+            return expr
+
+        def path_of(x) -> Optional[str]:
+            if isinstance(x, E.Var) and x.name in self.path_defs:
+                return x.name
+            return None
+
+        def start_id_expr(p: str) -> E.Expr:
+            # Id(Var(p)) rather than bare Var(p) for projected paths: the
+            # evaluators unwrap Id to the entity's id column, and the bare
+            # var would re-match this very rewrite (infinite recursion).
+            d = self.path_defs[p]
+            return E.Id(E.Var(p)) if d.projected \
+                else E.Id(E.Var(d.node_vars[0]))
+
+        def rels_expr(p: str) -> E.Expr:
+            d = self.path_defs[p]
+            acc: Optional[E.Expr] = None
+            for i, vl in enumerate(d.varlen):
+                piece = self._path_rel_piece(d, p, i)
+                if not vl:
+                    piece = E.ListLit((piece,))
+                acc = piece if acc is None else E.Add(acc, piece)
+            return acc if acc is not None else E.ListLit(())
+
+        def rule(n: E.Expr) -> E.Expr:
+            if isinstance(n, (E.Equals, E.NotEquals)):
+                pl, pr = path_of(n.lhs), path_of(n.rhs)
+                if pl is not None and pr is not None:
+                    # path equality = same start node + same relationship
+                    # id sequence (the node chain follows from those)
+                    eq = E.Ands((E.Equals(start_id_expr(pl),
+                                          start_id_expr(pr)),
+                                 E.Equals(rels_expr(pl), rels_expr(pr))))
+                    return E.Not(eq) if isinstance(n, E.NotEquals) else eq
+            if isinstance(n, (E.IsNull, E.IsNotNull)) \
+                    and (p := path_of(n.expr)) is not None:
+                d = self.path_defs[p]
+                witness = (self._path_rel_piece(d, p, 0) if d.varlen
+                           else start_id_expr(p))
+                return type(n)(witness)
+            if isinstance(n, E.FunctionExpr) and len(n.args) == 1 \
+                    and (p := path_of(n.args[0])) is not None:
+                d = self.path_defs[p]
+                k = len(d.varlen)  # hop count (rel_vars is empty once projected)
+                fname = n.name.lower()
+                if fname in ("length", "size"):
+                    out: E.Expr = E.Lit(sum(1 for v in d.varlen if not v))
+                    for i, vl in enumerate(d.varlen):
+                        if vl:
+                            out = E.Add(out, E.FunctionExpr(
+                                "size", (self._path_rel_piece(d, p, i),)))
+                    return out
+                if fname in ("relationships", "rels"):
+                    return rels_expr(p)
+                if fname == "nodes":
+                    if any(d.varlen):
+                        raise IRBuildError(
+                            "nodes() on a variable-length named path is not "
+                            "supported (interior nodes are unbound); use "
+                            "relationships() or length()")
+                    if d.projected:
+                        return E.ListLit(tuple(
+                            E.PathNode(E.Var(p), i) for i in range(k + 1)))
+                    return E.ListLit(tuple(E.Var(nv) for nv in d.node_vars))
+            if isinstance(n, E.Aggregator):
+                arg = getattr(n, "expr", None)
+                if (p := path_of(arg)) is not None:
+                    d = self.path_defs[p]
+                    if isinstance(n, E.Count) and not n.distinct:
+                        # count(p) = count of non-null paths.  The witness
+                        # must be a column that is null exactly when the
+                        # (optional) path is: the FIRST HOP's rel binding —
+                        # the start node may be bound outside the OPTIONAL
+                        # MATCH and hence non-null on a failed match.
+                        # Zero-hop paths are their start node.
+                        if d.projected:
+                            if d.varlen:
+                                return E.Count(E.PathSeg(E.Var(p), 0,
+                                                         d.varlen[0]))
+                            return n  # zero-hop: the path column itself
+                        if d.rel_vars:
+                            return E.Count(E.Var(d.rel_vars[0]))
+                        return E.Count(E.Id(E.Var(d.node_vars[0])))
+                    raise IRBuildError(
+                        f"aggregating path values ({type(n).__name__.lower()}"
+                        f" over `{p}`) is not supported; aggregate "
+                        f"length({p})/nodes({p})/relationships({p}) instead")
+            if (p := path_of(n)) is not None:
+                d = self.path_defs[p]
+                if d.projected:
+                    return n  # real header var: passthrough / aliasing
+                return E.PathExpr(
+                    tuple(E.Var(nv) for nv in d.node_vars),
+                    tuple(E.Var(rv) for rv in d.rel_vars), d.varlen)
+            return n
+
+        return expr.transform_down(rule)
+
+    def _resolve(self, expr: E.Expr) -> E.Expr:
+        return self._resolve_paths(self._resolve_exists(expr))
+
     def _property_predicates(self, var: str, props: E.Expr,
                              out: List[E.Expr]) -> None:
         if isinstance(props, E.MapLit):
@@ -261,9 +413,10 @@ class _SingleQueryBuilder:
     # -- UNWIND -------------------------------------------------------------
 
     def _add_unwind(self, clause: ast.UnwindClause) -> None:
-        t = self.typer.type_of(clause.expr, self.env)
+        expr = self._resolve(clause.expr)
+        t = self.typer.type_of(expr, self.env)
         inner = t.material.inner if isinstance(t.material, _CTList) else CTAny
-        self.blocks.append(UnwindBlock(clause.expr, clause.var))
+        self.blocks.append(UnwindBlock(expr, clause.var))
         self.env[clause.var] = inner
 
     # -- WITH / RETURN ------------------------------------------------------
@@ -274,7 +427,7 @@ class _SingleQueryBuilder:
         if body.star:
             for name in sorted(self.env):
                 if not name.startswith("__"):
-                    items.append((name, E.Var(name)))
+                    items.append((name, self._resolve(E.Var(name))))
         for item in body.items:
             if item.alias is not None:
                 name = item.alias
@@ -282,7 +435,7 @@ class _SingleQueryBuilder:
                 name = item.expr.name
             else:
                 name = item.expr.cypher_repr()
-            items.append((name, self._resolve_exists(item.expr)))
+            items.append((name, self._resolve(item.expr)))
         visible = [name for name, _ in items]
         defining: Dict[str, E.Expr] = dict(items)
 
@@ -306,6 +459,26 @@ class _SingleQueryBuilder:
                     needs_post = True
                     replaced = self._extract_aggs(expr, aggs)
                     post.append((name, replaced))
+            path_groups = [(n, x) for n, x in group
+                           if isinstance(x, E.PathExpr)]
+            if path_groups:
+                # Grouping by a path value: reify the path columns with a
+                # pre-projection, then group by the (multi-column) path var.
+                path_names = {n for n, _ in path_groups}
+                keep = [(v, E.Var(v)) for v in self.env
+                        if v not in path_names
+                        and (v not in self.path_defs
+                             or self.path_defs[v].projected)]
+                self.blocks.append(ProjectBlock(
+                    tuple(keep) + tuple(path_groups), distinct=False))
+                env2 = {v: self.env[v] for v, _ in keep}
+                for n, x in path_groups:
+                    env2[n] = CTPath
+                    self.path_defs[n] = _PathDef((), (), x.varlen,
+                                                 projected=True)
+                self.env = env2
+                group = [(n, E.Var(n) if isinstance(x, E.PathExpr) else x)
+                         for n, x in group]
             for gname, gexpr in group:
                 for v in E.vars_in(gexpr):
                     if v.name not in self.env:
@@ -332,7 +505,7 @@ class _SingleQueryBuilder:
             order_rewritten: List[Tuple[E.Expr, bool]] = []
             for oi in body.order_by:
                 expr = self._resolve_order_expr(
-                    self._resolve_exists(oi.expr), visible, defining)
+                    self._resolve(oi.expr), visible, defining)
                 # ORDER BY <expr> where <expr> is exactly a projected item's
                 # defining expression sorts by that item (openCypher rule).
                 for name, dexpr in items:
@@ -367,7 +540,7 @@ class _SingleQueryBuilder:
             order_rewritten = []
             for oi in body.order_by:
                 expr = self._resolve_order_expr(
-                    self._resolve_exists(oi.expr), visible, defining)
+                    self._resolve(oi.expr), visible, defining)
                 for name, dexpr in items:
                     if expr == dexpr:  # ORDER BY a grouping-key expression
                         expr = E.Var(name)
@@ -380,8 +553,20 @@ class _SingleQueryBuilder:
             self.blocks.append(OrderAndSliceBlock(
                 tuple(order_rewritten), body.skip, body.limit))
 
+        # Scope transition for named paths: a projected PathExpr becomes a
+        # real multi-column var (reads resolve to PathSeg/PathNode columns);
+        # everything else falls out of scope with its constituent vars.
+        new_defs: Dict[str, _PathDef] = {}
+        for name, expr in items:
+            if isinstance(expr, E.PathExpr):
+                new_defs[name] = _PathDef((), (), expr.varlen, projected=True)
+            elif isinstance(expr, E.Var) and expr.name in self.path_defs \
+                    and self.path_defs[expr.name].projected:
+                new_defs[name] = self.path_defs[expr.name]
+        self.path_defs = new_defs
+
         if where is not None:
-            self.blocks.append(FilterBlock(self._resolve_exists(where)))
+            self.blocks.append(FilterBlock(self._resolve(where)))
         if is_return:
             self.blocks.append(ResultBlock(tuple(visible)))
 

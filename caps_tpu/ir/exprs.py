@@ -143,6 +143,41 @@ class Properties(Expr):
     entity: Expr
 
 
+# -- paths ------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PathExpr(Expr):
+    """Defining expression of a named path ``p = (a)-[r]->(b)...``: the
+    constituent entity vars in pattern order.  ``nodes[i]`` / ``rels[i]``
+    are the bound node / rel vars; ``varlen[i]`` marks rel positions bound
+    to relationship LISTS (var-length segments).  Never reaches a backend:
+    the relational ProjectOp lowers it to path-owned id columns (ref:
+    front-end ``PathExpression``† — reconstructed, mount empty;
+    SURVEY.md §2 "IR")."""
+    nodes: Tuple[Expr, ...]
+    rels: Tuple[Expr, ...] = ()
+    varlen: Tuple[bool, ...] = ()
+
+    def cypher_repr(self) -> str:
+        return "path(...)"
+
+
+@dataclasses.dataclass(frozen=True)
+class PathSeg(Expr):
+    """Relationship (or rel-list) at hop ``index`` of a projected path
+    var — header-resident column, like StartNode/EndNode for rels."""
+    path: Expr
+    index: int
+    is_varlen: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class PathNode(Expr):
+    """Node id at position ``index`` of a projected fixed-length path."""
+    path: Expr
+    index: int
+
+
 # -- boolean (3-valued) -----------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

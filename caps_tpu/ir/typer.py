@@ -64,6 +64,21 @@ class SchemaTyper:
                 t = CTAny  # maps / CTAny entities: untyped property access
             return t.nullable if et.is_nullable and t != CTNull else t
 
+        if isinstance(e, E.PathExpr):
+            from caps_tpu.okapi.types import CTPath
+            return CTPath
+        if isinstance(e, E.PathSeg):
+            from caps_tpu.okapi.types import CTRelationship
+            t = rec(e.path)
+            out: CypherType = (CTList(CTRelationship()) if e.is_varlen
+                               else CTRelationship())
+            return out.nullable if t.is_nullable else out
+        if isinstance(e, E.PathNode):
+            from caps_tpu.okapi.types import CTNode
+            t = rec(e.path)
+            out = CTNode()
+            return out.nullable if t.is_nullable else out
+
         if isinstance(e, (E.HasLabel, E.HasType)):
             return CTBoolean
         if isinstance(e, E.Id):

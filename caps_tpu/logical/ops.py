@@ -69,6 +69,17 @@ class NodeScan(LogicalOperator):
 
 
 @dataclasses.dataclass(frozen=True)
+class RelScan(LogicalOperator):
+    """Scan of all relationships of the given types (used to rehydrate
+    unwound relationship ids; pattern rel scans are planned inside
+    Expand)."""
+    parent: LogicalOperator
+    var: str
+    rel_types: FrozenSet[str]
+    fields: Fields = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class Expand(LogicalOperator):
     """One hop from ``source``: join relationships (and the target node scan
     unless ``into``) onto the incoming rows.  ``direction`` is relative to
@@ -198,10 +209,12 @@ class CartesianProduct(LogicalOperator):
 
 @dataclasses.dataclass(frozen=True)
 class ValueJoin(LogicalOperator):
-    """Inner join on equality predicates ``lhs_expr = rhs_expr``."""
+    """Join on equality predicates ``lhs_expr = rhs_expr`` (inner unless
+    ``join_type`` says otherwise)."""
     lhs: LogicalOperator
     rhs: LogicalOperator
     predicates: Tuple[Expr, ...]
+    join_type: str = "inner"
     fields: Fields = ()
 
 

@@ -156,6 +156,30 @@ class _QueryPlanner:
         if isinstance(block, B.UnwindBlock):
             t = self.type_of(block.list_expr, op.env)
             inner = t.material.inner if isinstance(t.material, _CTList) else CTAny
+            if isinstance(inner.material, (_CTNode, _CTRelationship)):
+                # Entity lists hold ids in columnar form; rehydrate the
+                # unwound var by left-joining back to a full entity scan so
+                # labels/properties are accessible (left: UNWIND of a list
+                # containing null keeps the null row, openCypher).
+                self._marker_count += 1
+                tmp = f"__unwind_id_{self._marker_count}"
+                out = L.Unwind(op, block.list_expr, tmp,
+                               fields=op.fields + ((tmp, CTAny),))
+                if isinstance(inner.material, _CTNode):
+                    ent_t: CypherType = CTNode(inner.material.labels).nullable
+                    scan: L.LogicalOperator = L.NodeScan(
+                        L.Start(self.current_graph, fields=()), block.var,
+                        inner.material.labels, fields=((block.var, ent_t),))
+                else:
+                    ent_t = CTRelationship(inner.material.rel_types).nullable
+                    scan = L.RelScan(
+                        L.Start(self.current_graph, fields=()), block.var,
+                        inner.material.rel_types, fields=((block.var, ent_t),))
+                out = L.ValueJoin(
+                    out, scan, (E.Equals(E.Var(tmp), E.Var(block.var)),),
+                    join_type="left",
+                    fields=out.fields + ((block.var, ent_t),))
+                return self._select(out, op.field_names + (block.var,))
             return L.Unwind(op, block.list_expr, block.var,
                             fields=op.fields + ((block.var, inner),))
         if isinstance(block, B.FromGraphBlock):

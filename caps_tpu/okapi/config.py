@@ -71,6 +71,21 @@ class EngineConfig:
     # (VarExpandOp strategy "matrix") instead of the join cascade.
     use_ring: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_RING", True))
+    # Hand-scheduled distributed joins (parallel/dist_join.py, SURVEY.md
+    # §5.8): with a 1-D mesh, large-large joins ride an all_to_all radix
+    # exchange (each row crosses ICI once) instead of GSPMD's layout, and
+    # small build sides ride an explicit all_gather broadcast join.
+    use_dist_join: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_DIST_JOIN", True))
+    # Build sides at or under this many rows broadcast instead of
+    # exchanging (Spark's autoBroadcastJoinThreshold analog, in rows).
+    broadcast_join_threshold: int = dataclasses.field(
+        default_factory=lambda: _env_int("CAPS_TPU_BROADCAST_ROWS", 4096))
+    # Skew salting factor for the radix exchange: probe rows of one key
+    # spread over `join_salt` sub-buckets, build rows replicate into all
+    # of them (power-law key guards; 1 = off).
+    join_salt: int = dataclasses.field(
+        default_factory=lambda: _env_int("CAPS_TPU_JOIN_SALT", 1))
     # Fused executor (backends/tpu/fused.py): record data-dependent sizes
     # on a query's first run, replay them sync-free on repeats.
     use_fused: bool = dataclasses.field(

@@ -80,6 +80,48 @@ class CypherRelationship:
 FrozenLabels = Tuple[str, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class CypherPath:
+    """A materialized path: alternating nodes and relationships,
+    ``len(nodes) == len(rels) + 1``.  Equality is by the node/rel id
+    sequence (path identity), mirroring the reference's path value
+    (ref: okapi-api value model — reconstructed, mount empty;
+    SURVEY.md §2 "Value model")."""
+    nodes: Tuple[CypherNode, ...]
+    rels: Tuple["CypherRelationship", ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "nodes", tuple(self.nodes))
+        object.__setattr__(self, "rels", tuple(self.rels))
+        if len(self.nodes) != len(self.rels) + 1:
+            raise ValueError(
+                f"path needs {len(self.rels) + 1} nodes, got {len(self.nodes)}")
+
+    @property
+    def length(self) -> int:
+        return len(self.rels)
+
+    def __eq__(self, other):
+        return (isinstance(other, CypherPath)
+                and tuple(n.id for n in other.nodes) == tuple(n.id for n in self.nodes)
+                and tuple(r.id for r in other.rels) == tuple(r.id for r in self.rels))
+
+    def __hash__(self):
+        return hash(("path", tuple(n.id for n in self.nodes),
+                     tuple(r.id for r in self.rels)))
+
+    def __repr__(self):
+        parts = [repr(self.nodes[0])]
+        for i, rel in enumerate(self.rels):
+            prev, nxt = self.nodes[i], self.nodes[i + 1]
+            if rel.start == prev.id and rel.end == nxt.id:
+                parts.append(f"-{rel!r}->")
+            else:  # traversed against the stored orientation
+                parts.append(f"<-{rel!r}-")
+            parts.append(repr(nxt))
+        return "<" + "".join(parts) + ">"
+
+
 def _repr_value(v: CypherValue) -> str:
     if isinstance(v, str):
         return f"'{v}'"
@@ -103,6 +145,8 @@ def cypher_equals(a: CypherValue, b: CypherValue) -> Optional[bool]:
     if isinstance(a, CypherRelationship) or isinstance(b, CypherRelationship):
         return (isinstance(a, CypherRelationship)
                 and isinstance(b, CypherRelationship) and a.id == b.id)
+    if isinstance(a, CypherPath) or isinstance(b, CypherPath):
+        return isinstance(a, CypherPath) and isinstance(b, CypherPath) and a == b
     if isinstance(a, bool) or isinstance(b, bool):
         return isinstance(a, bool) and isinstance(b, bool) and a == b
     if isinstance(a, (int, float)) and isinstance(b, (int, float)):
@@ -135,7 +179,7 @@ def cypher_equals(a: CypherValue, b: CypherValue) -> Optional[bool]:
 
 
 _ORDER_RANK = {
-    "map": 0, "node": 1, "rel": 2, "list": 3, "str": 4,
+    "map": 0, "node": 1, "rel": 2, "list": 3, "path": 3.5, "str": 4,
     "bool": 5, "num": 6, "null": 7,
 }
 
@@ -155,6 +199,9 @@ def _order_key(v: CypherValue) -> Tuple:
         return (_ORDER_RANK["node"], v.id)
     if isinstance(v, CypherRelationship):
         return (_ORDER_RANK["rel"], v.id)
+    if isinstance(v, CypherPath):
+        return (_ORDER_RANK["path"], tuple(n.id for n in v.nodes),
+                tuple(r.id for r in v.rels))
     if isinstance(v, (list, tuple)):
         return (_ORDER_RANK["list"], tuple(_order_key(x) for x in v))
     if isinstance(v, dict):

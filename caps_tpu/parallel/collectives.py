@@ -25,6 +25,49 @@ def shard_of(key: jnp.ndarray, n_shards: int) -> jnp.ndarray:
     return (key % n_shards).astype(jnp.int32)
 
 
+def salted_dest(key: jnp.ndarray, n_shards: int, salt: int,
+                salt_id: jnp.ndarray | None) -> jnp.ndarray:
+    """Destination device of a key.  With salting, sub-bucket ``s`` of a
+    key lands ``s * (n_shards // salt)`` devices away — the ``salt``
+    sub-buckets of one key hit ``salt`` DISTINCT devices (stride
+    ``n // salt``, ids ``s*stride < n`` pairwise distinct).  The skew
+    guard of the radix-exchange join (SURVEY.md §5.8 'salting hot keys')."""
+    base = (jnp.abs(key) % jnp.int64(n_shards)).astype(jnp.int32)
+    if salt > 1 and salt_id is not None:
+        stride = max(1, n_shards // salt)
+        base = (base + salt_id.astype(jnp.int32) * stride) % n_shards
+    return base
+
+
+def bin_positions(dest: jnp.ndarray, ok: jnp.ndarray, n_shards: int,
+                  bin_cap: int):
+    """Within-bin position per row for a binned exchange; overflowed rows
+    are counted and get an out-of-range destination so the scatter drops
+    them (callers retry with a bigger ``bin_cap`` when ``dropped > 0``)."""
+    dest = jnp.where(ok, dest, n_shards)
+    one_hot = (dest[:, None] == jnp.arange(n_shards)[None, :]).astype(jnp.int32)
+    pos = jnp.cumsum(one_hot, axis=0) - 1
+    row_pos = jnp.where(ok, jnp.take_along_axis(
+        pos, jnp.clip(dest, 0, n_shards - 1)[:, None], axis=1)[:, 0], 0)
+    sent = ok & (row_pos < bin_cap)
+    dropped = (ok & ~sent).sum()
+    dest = jnp.where(sent, dest, n_shards)
+    return dest, row_pos, dropped
+
+
+def exchange_binned(arr: jnp.ndarray, dest: jnp.ndarray,
+                    row_pos: jnp.ndarray, n_shards: int, bin_cap: int,
+                    axis: str, fill) -> jnp.ndarray:
+    """Scatter local rows into (n_shards, bin_cap) bins (out-of-range
+    destinations drop) and all_to_all: device i receives every other
+    device's bin i → (n_shards, bin_cap)."""
+    binned = jnp.full((n_shards, bin_cap), fill, arr.dtype)
+    binned = binned.at[dest, jnp.clip(row_pos, 0, bin_cap - 1)].set(
+        arr, mode="drop")
+    return lax.all_to_all(binned, axis, split_axis=0, concat_axis=0,
+                          tiled=False)
+
+
 def exchange_by_shard(data: jnp.ndarray, dest: jnp.ndarray, n_shards: int,
                       axis: str, capacity: int) -> jnp.ndarray:
     """All-to-all exchange: each device buckets its rows by ``dest`` into
@@ -32,16 +75,10 @@ def exchange_by_shard(data: jnp.ndarray, dest: jnp.ndarray, n_shards: int,
     Returns the received (n_shards, capacity) buckets; slots beyond each
     bin's fill are garbage — callers carry a validity channel the same way.
     """
-    binned = jnp.zeros((n_shards, capacity), data.dtype)
-    # position of each row within its destination bin
-    one_hot = jax.nn.one_hot(dest, n_shards, dtype=jnp.int32)
-    pos = jnp.cumsum(one_hot, axis=0) - 1
-    row_pos = jnp.take_along_axis(pos, dest[:, None], axis=1)[:, 0]
-    ok = row_pos < capacity
-    binned = binned.at[dest, jnp.where(ok, row_pos, capacity - 1)].set(
-        jnp.where(ok, data, binned[0, 0]))
-    return lax.all_to_all(binned, axis, split_axis=0, concat_axis=0,
-                          tiled=False)
+    ok = jnp.ones(data.shape[0], bool)
+    dest, row_pos, _ = bin_positions(dest, ok, n_shards, capacity)
+    return exchange_binned(data, dest, row_pos, n_shards, capacity, axis,
+                           jnp.zeros((), data.dtype))
 
 
 def ring_shift(x: jnp.ndarray, axis: str, n_shards: int,

@@ -69,6 +69,11 @@ class RelationalCypherGraph(PropertyGraph):
         materialize variable-length relationship lists."""
         return {}
 
+    def node_lookup(self):
+        """Host-side map node-id -> (labels, props), used to materialize
+        path values and node lists."""
+        return {}
+
 
 def _align_node_scan(nt: NodeTable, header: RecordHeader, var: str,
                      all_labels: Iterable[str]) -> Table:
@@ -131,10 +136,27 @@ class ScanGraph(RelationalCypherGraph):
             schema = schema.union(rt.schema())
         self._schema = schema
         self._rel_lookup_cache = None
+        self._node_lookup_cache = None
 
     @property
     def schema(self) -> Schema:
         return self._schema
+
+    def node_lookup(self):
+        if self._node_lookup_cache is None:
+            out = {}
+            for nt in self.node_tables:
+                m = nt.mapping
+                t = nt.table
+                ids = t.column_values(m.id_col)
+                props = {key: t.column_values(col)
+                         for key, col in m.property_cols.items()}
+                labels = tuple(sorted(nt.labels))
+                for i, nid in enumerate(ids):
+                    p = {k: v[i] for k, v in props.items() if v[i] is not None}
+                    out[nid] = (labels, p)
+            self._node_lookup_cache = out
+        return self._node_lookup_cache
 
     def rel_lookup(self):
         if self._rel_lookup_cache is None:
@@ -228,6 +250,12 @@ class UnionGraph(RelationalCypherGraph):
         out = {}
         for g in self.graphs:
             out.update(g.rel_lookup())
+        return out
+
+    def node_lookup(self):
+        out = {}
+        for g in self.graphs:
+            out.update(g.node_lookup())
         return out
 
     def _union_scans(self, header: RecordHeader,

@@ -52,6 +52,10 @@ def column_name_for(expr: E.Expr, entity_vars: Iterable[str]) -> str:
         return f"{expr.rel.name}__type"
     if isinstance(expr, E.Property) and isinstance(expr.entity, E.Var):
         return f"{expr.entity.name}__prop_{expr.key}"
+    if isinstance(expr, E.PathSeg) and isinstance(expr.path, E.Var):
+        return f"{expr.path.name}__seg{expr.index}"
+    if isinstance(expr, E.PathNode) and isinstance(expr.path, E.Var):
+        return f"{expr.path.name}__node{expr.index}"
     raise HeaderError(f"no canonical column name for {expr!r}")
 
 
@@ -105,6 +109,16 @@ class RecordHeader:
         for e, _, t in self._entries:
             if isinstance(e, E.Var) and isinstance(
                     t.material, (_CTNode, _CTRelationship)):
+                out.append(e.name)
+        return tuple(out)
+
+    @property
+    def composite_vars(self) -> Tuple[str, ...]:
+        """Vars owning multiple columns: entity vars plus path vars."""
+        from caps_tpu.okapi.types import _CTPath
+        out = list(self.entity_vars)
+        for e, _, t in self._entries:
+            if isinstance(e, E.Var) and isinstance(t.material, _CTPath):
                 out.append(e.name)
         return tuple(out)
 

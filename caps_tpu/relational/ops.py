@@ -105,10 +105,18 @@ class RelationalOperator(abc.ABC):
                     if _TraceAnnotation is not None else nullcontext())
             with span:
                 self._result = self._compute()
+            try:  # bytes pulled through memory by this operator (children
+                # are already evaluated, so .table reads the cache): the
+                # roofline numerator (SURVEY.md §5.5)
+                bytes_in = (sum(c.table.nbytes for c in self.children)
+                            if self.children else self._result[1].nbytes)
+            except Exception:  # pragma: no cover — accounting must not fail
+                bytes_in = 0
             self.context.op_metrics.append({
                 "op": name,
                 "seconds": time.perf_counter() - t0,
                 "rows": self._result[1].size,
+                "bytes_in": bytes_in,
                 **getattr(self, "_metric_extra", {}),
             })
         return self._result
@@ -222,15 +230,19 @@ class ProjectOp(RelationalOperator):
         for name, expr, ctype in self.items:
             target = name
             tmp_prefix = f"__new__{name}" if name in overwritten else name
-            if isinstance(expr, E.Var) and expr.name in header.entity_vars:
-                # entity alias: copy all owned columns under the new prefix
+            if isinstance(expr, E.Var) and expr.name in header.composite_vars:
+                # entity/path alias: copy all owned columns under the new
+                # prefix (paths own __start/__seg*/__node* columns)
                 src = expr.name
                 sub = header.select_vars([src])
+                copied = set()
                 for e in sub.exprs:
                     old_col = sub.column(e)
                     suffix = old_col[len(src):]  # '__id', '__prop_x', ...
                     new_col = f"{tmp_prefix}{suffix}"
-                    table = table.copy_column(old_col, new_col)
+                    if old_col not in copied:
+                        table = table.copy_column(old_col, new_col)
+                        copied.add(old_col)
                     ne = e.transform_down(
                         lambda n: E.Var(target) if n == E.Var(src) else n)
                     final_col = f"{target}{suffix}"
@@ -238,6 +250,35 @@ class ProjectOp(RelationalOperator):
                         pending_renames[new_col] = final_col
                     t = ctype if e == E.Var(src) else sub.type_of(e)
                     new_entries.append((ne, final_col, t))
+            elif isinstance(expr, E.PathExpr):
+                # reify a named path: path-owned copies of the constituent
+                # id columns — start node id + one column per hop (rel id,
+                # or rel-id list for var-length segments); fixed-length
+                # paths also pin per-position node ids for nodes(p)
+                pv = E.Var(target)
+                fixed = not any(expr.varlen)
+
+                def path_col(src_expr, suffix, entry_expr, etype):
+                    nonlocal table
+                    tmp_col = f"{tmp_prefix}{suffix}"
+                    table = table.copy_column(header.column(src_expr), tmp_col)
+                    final_col = f"{target}{suffix}"
+                    if tmp_col != final_col:
+                        pending_renames[tmp_col] = final_col
+                    new_entries.append((entry_expr, final_col, etype))
+                    return final_col
+
+                start_col = path_col(expr.nodes[0], "__start", pv, ctype)
+                if fixed:
+                    new_entries.append((E.PathNode(pv, 0), start_col,
+                                        header.type_of(expr.nodes[0])))
+                for i, (rexpr, vl) in enumerate(zip(expr.rels, expr.varlen)):
+                    path_col(rexpr, f"__seg{i}", E.PathSeg(pv, i, vl),
+                             header.type_of(rexpr))
+                if fixed:
+                    for i, nexpr in enumerate(expr.nodes[1:], start=1):
+                        path_col(nexpr, f"__node{i}", E.PathNode(pv, i),
+                                 header.type_of(nexpr))
             else:
                 resolved = resolve_expr(expr, header)
                 if isinstance(resolved, E.Var) and resolved.name in header.vars:
@@ -408,6 +449,22 @@ class AggregateOp(RelationalOperator):
                     else:
                         first_specs.append(AggSpec(new_col, "first", old_col,
                                                    result_type=t))
+                    out_entries.append((ne, new_col, t))
+            elif isinstance(expr, E.Var) and expr.name in header.composite_vars:
+                # path var: path identity = the full column tuple (start id
+                # + every hop id column), so group by all of them
+                src = expr.name
+                sub = header.select_vars([src])
+                for e in sub.exprs:
+                    old_col = sub.column(e)
+                    suffix = old_col[len(src):]
+                    new_col = f"{name}{suffix}"
+                    ne = e.transform_down(
+                        lambda n: E.Var(name) if n == E.Var(src) else n)
+                    t = ctype if e == E.Var(src) else sub.type_of(e)
+                    if old_col not in by_cols:
+                        by_cols.append(old_col)
+                        renames[old_col] = new_col
                     out_entries.append((ne, new_col, t))
             else:
                 resolved = resolve_expr(expr, header)

@@ -224,7 +224,7 @@ class RelationalPlanner:
             if isinstance(op, L.BoundedVarLengthExpand):
                 varlen_binds[op.rel] = varlen_binds.get(op.rel, 0) + 1
                 other_binds.add(op.target)
-            elif isinstance(op, (L.NodeScan,)):
+            elif isinstance(op, (L.NodeScan, L.RelScan)):
                 other_binds.add(op.var)
             elif isinstance(op, L.Expand):
                 other_binds.update((op.rel, op.target))
@@ -281,6 +281,10 @@ class RelationalPlanner:
         if isinstance(op, L.NodeScan):
             self.plan_op(op.parent)  # graph-context side effects (FromGraph)
             return R.ScanOp(ctx, self.current_graph, op.var, CTNode(op.labels))
+        if isinstance(op, L.RelScan):
+            self.plan_op(op.parent)
+            return R.ScanOp(ctx, self.current_graph, op.var,
+                            CTRelationship(op.rel_types))
         if isinstance(op, L.Expand):
             return self._plan_expand(op)
         if isinstance(op, L.BoundedVarLengthExpand):
@@ -361,7 +365,7 @@ class RelationalPlanner:
                         f"ValueJoin predicate must be equality: {pred!r}")
                 pairs.append((pred.lhs, pred.rhs))
             l, r = self._plan_two(op.lhs, op.rhs)
-            return R.JoinOp(ctx, l, r, pairs, "inner")
+            return R.JoinOp(ctx, l, r, pairs, op.join_type)
         if isinstance(op, L.TabularUnionAll):
             l, r = self._plan_two(op.lhs, op.rhs, keep="pre")
             return R.UnionAllOp(ctx, l, r)

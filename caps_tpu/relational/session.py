@@ -28,9 +28,9 @@ from caps_tpu.okapi.graph import (
 )
 from caps_tpu.okapi.schema import Schema
 from caps_tpu.okapi.types import (
-    CypherType, _CTList, _CTNode, _CTRelationship,
+    CypherType, _CTList, _CTNode, _CTPath, _CTRelationship,
 )
-from caps_tpu.okapi.values import CypherNode, CypherRelationship
+from caps_tpu.okapi.values import CypherNode, CypherPath, CypherRelationship
 from caps_tpu.relational import ops as R
 from caps_tpu.relational.graphs import EmptyGraph, RelationalCypherGraph, ScanGraph
 from caps_tpu.relational.header import RecordHeader
@@ -106,6 +106,14 @@ class RelationalCypherRecords(CypherRecords):
             return [None if ids is None else
                     [self._rel_from_lookup(i, lookup) for i in ids]
                     for ids in ids_list]
+        if isinstance(t, _CTList) and isinstance(t.inner.material, _CTNode):
+            ids_list = table.column_values(header.column(var))
+            lookup = self._node_lookup()
+            return [None if ids is None else
+                    [self._node_from_lookup(i, lookup) for i in ids]
+                    for ids in ids_list]
+        if isinstance(t, _CTPath):
+            return self._materialize_paths(name, header, table, n)
         return table.column_values(header.column(var))
 
     def _materialize_nodes(self, name, header, table, n) -> List[Any]:
@@ -118,6 +126,12 @@ class RelationalCypherRecords(CypherRecords):
                 label_cols.append((e.label, table.column_values(header.column(e))))
             elif isinstance(e, E.Property) and e.entity == var:
                 prop_cols.append((e.key, table.column_values(header.column(e))))
+        if not label_cols and not prop_cols:
+            # bare id column (e.g. an indexed element of nodes(p)): fill
+            # labels/properties from the graph's host-side lookup
+            lookup = self._node_lookup()
+            return [None if i is None else self._node_from_lookup(i, lookup)
+                    for i in ids]
         out = []
         for i in range(n):
             if ids[i] is None:
@@ -131,6 +145,12 @@ class RelationalCypherRecords(CypherRecords):
     def _materialize_rels(self, name, header, table, n) -> List[Any]:
         var = E.Var(name)
         ids = table.column_values(header.column(var))
+        if not header.has(E.StartNode(var)):
+            # bare rel-id column (e.g. an indexed element of
+            # relationships(p)): materialize via the graph lookup
+            lookup = self._rel_lookup()
+            return [None if i is None else self._rel_from_lookup(i, lookup)
+                    for i in ids]
         srcs = table.column_values(header.column(E.StartNode(var)))
         tgts = table.column_values(header.column(E.EndNode(var)))
         types = table.column_values(header.column(E.Type(var)))
@@ -148,10 +168,57 @@ class RelationalCypherRecords(CypherRecords):
                                           types[i] or "", props))
         return out
 
+    def _materialize_paths(self, name, header, table, n) -> List[Any]:
+        """Assemble path values: start node id + per-hop rel id (or rel-id
+        list) columns, walking each hop's stored endpoints to find the next
+        node (direction-agnostic: next = the endpoint that isn't current,
+        which also handles undirected matches and self-loops)."""
+        var = E.Var(name)
+        starts = table.column_values(header.column(var))
+        segs = sorted(
+            ((e.index, e.is_varlen, table.column_values(header.column(e)))
+             for e in header.exprs
+             if isinstance(e, E.PathSeg) and e.path == var),
+            key=lambda s: s[0])
+        rel_lk = self._rel_lookup()
+        node_lk = self._node_lookup()
+        out: List[Any] = []
+        for i in range(n):
+            if starts[i] is None:
+                out.append(None)
+                continue
+            cur = starts[i]
+            nodes = [self._node_from_lookup(cur, node_lk)]
+            rels: List[CypherRelationship] = []
+            dead = False
+            for _, is_varlen, col in segs:
+                cell = col[i]
+                if cell is None:
+                    dead = True  # null hop (optional path): whole path null
+                    break
+                for rid in (cell if is_varlen else [cell]):
+                    rel = self._rel_from_lookup(rid, rel_lk)
+                    rels.append(rel)
+                    cur = rel.end if rel.start == cur else rel.start
+                    nodes.append(self._node_from_lookup(cur, node_lk))
+            out.append(None if dead else CypherPath(tuple(nodes), tuple(rels)))
+        return out
+
     def _rel_lookup(self) -> Dict[int, Tuple[int, int, str, Dict[str, Any]]]:
         if self._graph is None:
             return {}
         return self._graph.rel_lookup()
+
+    def _node_lookup(self) -> Dict[int, Tuple[Tuple[str, ...], Dict[str, Any]]]:
+        if self._graph is None:
+            return {}
+        return self._graph.node_lookup()
+
+    def _node_from_lookup(self, nid, lookup) -> CypherNode:
+        if nid in lookup:
+            labels, props = lookup[nid]
+            return CypherNode(nid, labels, props)
+        return CypherNode(nid)
 
     def _rel_from_lookup(self, rid, lookup) -> CypherRelationship:
         if rid in lookup:
@@ -283,6 +350,10 @@ class RelationalCypherSession(CypherSession):
             "relational_s": t4 - t3, "execute_s": t5 - t4,
             "rows": records.size() if records is not None else 0,
             "operators": context.op_metrics,
+            # roofline numerator: bytes the operators pulled through
+            # memory; achieved GB/s = bytes_touched / execute_s
+            "bytes_touched": sum(m.get("bytes_in", 0)
+                                 for m in context.op_metrics),
         }
         if self.config.print_timings:
             print(f"[caps-tpu] timings: {metrics}")

@@ -56,6 +56,15 @@ class Table(abc.ABC):
     def size(self) -> int:
         ...
 
+    @property
+    def nbytes(self) -> int:
+        """Approximate resident bytes of this table's columns — the input
+        to the per-operator bytes-touched accounting (SURVEY.md §5.5; the
+        single-chip roofline proxy: achieved GB/s = bytes / wall-clock).
+        Backends override with exact buffer sizes; the default assumes 8
+        bytes + validity per cell."""
+        return self.size * len(self.columns) * 9
+
     @abc.abstractmethod
     def column_type(self, col: str) -> CypherType:
         ...

@@ -1,0 +1,287 @@
+Feature: Named paths
+
+  Scenario: returning a single-hop path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {name: 'a'})-[:T]->(b:B {name: 'b'})
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->(b) RETURN p
+      """
+    Then the result should be, in any order:
+      | p                                           |
+      | <(:A {name: 'a'})-[:T]->(:B {name: 'b'})>   |
+
+  Scenario: returning a path matched against the stored direction
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)
+      """
+    When executing query:
+      """
+      MATCH p = (b:B)<-[:T]-(a:A) RETURN p
+      """
+    Then the result should be, in any order:
+      | p                     |
+      | <(:B)<-[:T]-(:A)>     |
+
+  Scenario: zero-hop path is a single node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {name: 'a'})
+      """
+    When executing query:
+      """
+      MATCH p = (a:A) RETURN p, length(p)
+      """
+    Then the result should be, in any order:
+      | p                  | length(p) |
+      | <(:A {name: 'a'})> | 0         |
+
+  Scenario: length of a fixed two-hop path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)-[:T]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->()-[:T]->(c) RETURN length(p)
+      """
+    Then the result should be, in any order:
+      | length(p) |
+      | 2         |
+
+  Scenario: nodes() of a fixed-length path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n: 1})-[:T]->(b:B {n: 2})
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->(b) RETURN nodes(p)
+      """
+    Then the result should be, in any order:
+      | nodes(p)                     |
+      | [(:A {n: 1}), (:B {n: 2})]   |
+
+  Scenario: relationships() of a fixed-length path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T {k: 1}]->(b:B)-[:S {k: 2}]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->()-[:S]->(c) RETURN relationships(p)
+      """
+    Then the result should be, in any order:
+      | relationships(p)            |
+      | [[:T {k: 1}], [:S {k: 2}]]  |
+
+  Scenario: length of a variable-length path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)-[:T]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T*1..2]->(x) RETURN length(p)
+      """
+    Then the result should be, in any order:
+      | length(p) |
+      | 1         |
+      | 2         |
+
+  Scenario: returning a variable-length path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T {i: 1}]->(b:B)-[:T {i: 2}]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T*2]->(c) RETURN p
+      """
+    Then the result should be, in any order:
+      | p                                        |
+      | <(:A)-[:T {i: 1}]->(:B)-[:T {i: 2}]->(:C)> |
+
+  Scenario: relationships() of a variable-length path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T {i: 1}]->(b:B)-[:T {i: 2}]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T*2]->(c) RETURN relationships(p)
+      """
+    Then the result should be, in any order:
+      | relationships(p)            |
+      | [[:T {i: 1}], [:T {i: 2}]]  |
+
+  Scenario: filtering on path length in WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)-[:T]->(c:C)-[:T]->(d:D)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T*1..3]->(x) WHERE length(p) >= 2 RETURN length(p)
+      """
+    Then the result should be, in any order:
+      | length(p) |
+      | 2         |
+      | 3         |
+
+  Scenario: path variable survives WITH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->(b) WITH p RETURN p, length(p)
+      """
+    Then the result should be, in any order:
+      | p                 | length(p) |
+      | <(:A)-[:T]->(:B)> | 1         |
+
+  Scenario: aliased path through WITH keeps its shape
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->(b) WITH p AS q RETURN q, length(q), nodes(q)
+      """
+    Then the result should be, in any order:
+      | q                 | length(q) | nodes(q)     |
+      | <(:A)-[:T]->(:B)> | 1         | [(:A), (:B)] |
+
+  Scenario: undirected named path reports traversal orientation
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)
+      """
+    When executing query:
+      """
+      MATCH p = (b:B)-[:T]-(a:A) RETURN p
+      """
+    Then the result should be, in any order:
+      | p                 |
+      | <(:B)<-[:T]-(:A)> |
+
+  Scenario: multiple named paths in one MATCH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B), (b)-[:S]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->(b), q = (b)-[:S]->(c) RETURN length(p), length(q)
+      """
+    Then the result should be, in any order:
+      | length(p) | length(q) |
+      | 1         | 1         |
+
+  Scenario: zero-length var-length path binds start node only
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n: 1})-[:T]->(b:B {n: 2})
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T*0..1]->(x) RETURN p
+      """
+    Then the result should be, in any order:
+      | p                                  |
+      | <(:A {n: 1})>                      |
+      | <(:A {n: 1})-[:T]->(:B {n: 2})>    |
+
+  Scenario: distinct paths are distinct values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B), (a)-[:T]->(c:B)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->(b) RETURN DISTINCT p
+      """
+    Then the result should be, in any order:
+      | p                 |
+      | <(:A)-[:T]->(:B)> |
+      | <(:A)-[:T]->(:B)> |
+
+  Scenario: path through an OPTIONAL MATCH that finds nothing is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)
+      """
+    When executing query:
+      """
+      MATCH (a:A) OPTIONAL MATCH p = (a)-[:T]->(b) RETURN p
+      """
+    Then the result should be, in any order:
+      | p    |
+      | null |
+
+  Scenario: unwinding the nodes of a path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n: 1})-[:T]->(b:B {n: 2})
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->(b) UNWIND nodes(p) AS x RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n |
+      | 1 |
+      | 2 |
+
+  Scenario: counting paths groups by path identity
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B), (a)-[:T]->(c:B)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T]->(b) RETURN length(p) AS l, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | l | c |
+      | 1 | 2 |
+
+  Scenario: ordering by path length
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)-[:T]->(b:B)-[:T]->(c:C)
+      """
+    When executing query:
+      """
+      MATCH p = (a:A)-[:T*1..2]->(x) RETURN length(p) AS l ORDER BY l DESC
+      """
+    Then the result should be, in order:
+      | l |
+      | 2 |
+      | 1 |

@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Tuple
 
-from caps_tpu.okapi.values import CypherNode, CypherRelationship
+from caps_tpu.okapi.values import CypherNode, CypherPath, CypherRelationship
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,10 +47,43 @@ class RelMatcher:
         return f"[:{self.rel_type}" + (f" {{{props}}}]" if props else "]")
 
 
+@dataclasses.dataclass(frozen=True)
+class PathMatcher:
+    """Structural path expectation ``<(:A)-[:T]->(:B)>``: node/rel matchers
+    in order plus per-hop direction (True = forward as written)."""
+    nodes: Tuple[NodeMatcher, ...]
+    rels: Tuple[RelMatcher, ...]
+    forward: Tuple[bool, ...]
+
+    def matches(self, v: Any) -> bool:
+        if not isinstance(v, CypherPath):
+            return False
+        if len(v.nodes) != len(self.nodes) or len(v.rels) != len(self.rels):
+            return False
+        if not all(m.matches(n) for m, n in zip(self.nodes, v.nodes)):
+            return False
+        for i, (m, r) in enumerate(zip(self.rels, v.rels)):
+            if not m.matches(r):
+                return False
+            prev, nxt = v.nodes[i].id, v.nodes[i + 1].id
+            want = (prev, nxt) if self.forward[i] else (nxt, prev)
+            if (r.start, r.end) != want:
+                return False
+        return True
+
+    def __repr__(self):
+        parts = [repr(self.nodes[0])]
+        for i, r in enumerate(self.rels):
+            arrow = f"-{r!r}->" if self.forward[i] else f"<-{r!r}-"
+            parts.append(arrow)
+            parts.append(repr(self.nodes[i + 1]))
+        return "<" + "".join(parts) + ">"
+
+
 def values_equal(expected: Any, actual: Any) -> bool:
     """Structural equality between a parsed TCK value and an engine value.
     Booleans are distinct from integers (Cypher has no bool/int coercion)."""
-    if isinstance(expected, (NodeMatcher, RelMatcher)):
+    if isinstance(expected, (NodeMatcher, RelMatcher, PathMatcher)):
         return expected.matches(actual)
     if expected is None or actual is None:
         return expected is None and actual is None
@@ -121,9 +154,46 @@ class _Parser:
             return self.map_literal()
         if c == "(":
             return self.node()
+        if c == "<":
+            return self.path()
         if c.isdigit() or c == "-":
             return self.number()
         return self.word()
+
+    def path(self) -> "PathMatcher":
+        self.expect("<")
+        self.skip_ws()
+        nodes = [self.node()]
+        rels: List[RelMatcher] = []
+        forward: List[bool] = []
+        while True:
+            self.skip_ws()
+            if self.accept(">"):
+                return PathMatcher(tuple(nodes), tuple(rels), tuple(forward))
+            if self.accept("<"):  # <-[:T]-
+                self.expect("-")
+                self.skip_ws()
+                rel = self.bracket_rel()
+                self.skip_ws()
+                self.expect("-")
+                forward.append(False)
+            else:                 # -[:T]->
+                self.expect("-")
+                self.skip_ws()
+                rel = self.bracket_rel()
+                self.skip_ws()
+                self.expect("-")
+                self.expect(">")
+                forward.append(True)
+            rels.append(rel)
+            self.skip_ws()
+            nodes.append(self.node())
+
+    def bracket_rel(self) -> "RelMatcher":
+        v = self.bracket()
+        if not isinstance(v, RelMatcher):
+            raise self.error("expected a relationship in path")
+        return v
 
     def string(self) -> str:
         self.expect("'")

@@ -1,0 +1,190 @@
+"""Named-path behaviour: p = (a)-[r]->(b), nodes()/relationships()/length(),
+path values through WITH, var-length paths (round-4 VERDICT item 3; the
+reference carries these through okapi-ir Pattern / front-end
+PathExpression — reconstructed, mount empty)."""
+from caps_tpu.okapi.values import CypherPath
+
+
+def test_return_path_value(init_graph, run):
+    g = init_graph("CREATE (:A {n: 1})-[:T {w: 5}]->(:B {n: 2})")
+    rows = run(g, "MATCH p = (a:A)-[:T]->(b) RETURN p")
+    assert len(rows) == 1
+    p = rows[0]["p"]
+    assert isinstance(p, CypherPath)
+    assert [n.labels for n in p.nodes] == [("A",), ("B",)]
+    assert [n.properties for n in p.nodes] == [{"n": 1}, {"n": 2}]
+    assert [r.rel_type for r in p.rels] == ["T"]
+    assert p.rels[0].properties == {"w": 5}
+    assert p.rels[0].start == p.nodes[0].id
+    assert p.rels[0].end == p.nodes[1].id
+
+
+def test_length_nodes_relationships_fixed(init_graph, run):
+    g = init_graph("CREATE (:A {n: 1})-[:T]->(:B {n: 2})-[:S]->(:C {n: 3})")
+    rows = run(g, "MATCH p = (:A)-[:T]->()-[:S]->(c) "
+                  "RETURN length(p) AS l, nodes(p) AS ns, "
+                  "relationships(p) AS rs")
+    assert len(rows) == 1
+    assert rows[0]["l"] == 2
+    assert [n.properties["n"] for n in rows[0]["ns"]] == [1, 2, 3]
+    assert [r.rel_type for r in rows[0]["rs"]] == ["T", "S"]
+
+
+def test_zero_hop_path(init_graph, run):
+    g = init_graph("CREATE (:A {n: 1})")
+    rows = run(g, "MATCH p = (a:A) RETURN p, length(p) AS l")
+    assert rows[0]["l"] == 0
+    assert len(rows[0]["p"].nodes) == 1
+    assert rows[0]["p"].rels == ()
+
+
+def test_var_length_path_value_and_length(init_graph, run):
+    g = init_graph("CREATE (:A {n: 1})-[:T]->(:B {n: 2})-[:T]->(:C {n: 3})")
+    rows = run(g, "MATCH p = (:A)-[:T*1..2]->(x) RETURN length(p) AS l")
+    assert sorted(r["l"] for r in rows) == [1, 2]
+    rows = run(g, "MATCH p = (:A)-[:T*2]->(x) RETURN p")
+    p = rows[0]["p"]
+    assert [n.properties["n"] for n in p.nodes] == [1, 2, 3]
+    assert len(p.rels) == 2
+
+
+def test_path_through_with_and_alias(init_graph, run):
+    g = init_graph("CREATE (:A)-[:T]->(:B)")
+    rows = run(g, "MATCH p = (:A)-[:T]->(b) WITH p AS q "
+                  "RETURN q, length(q) AS l, nodes(q) AS ns")
+    assert rows[0]["l"] == 1
+    assert isinstance(rows[0]["q"], CypherPath)
+    assert len(rows[0]["ns"]) == 2
+
+
+def test_incoming_and_undirected_path_orientation(init_graph, run):
+    g = init_graph("CREATE (:A {n: 1})-[:T]->(:B {n: 2})")
+    rows = run(g, "MATCH p = (b:B)<-[:T]-(a:A) RETURN p")
+    p = rows[0]["p"]
+    # traversal starts at b; the rel is stored a->b
+    assert p.nodes[0].labels == ("B",)
+    assert p.rels[0].start == p.nodes[1].id
+    rows = run(g, "MATCH p = (b:B)-[:T]-(a) RETURN p")
+    assert rows[0]["p"].nodes[0].labels == ("B",)
+
+
+def test_optional_match_null_path(init_graph, run):
+    g = init_graph("CREATE (:A)")
+    rows = run(g, "MATCH (a:A) OPTIONAL MATCH p = (a)-[:T]->(b) RETURN p")
+    assert rows == [{"p": None}]
+
+
+def test_path_length_filter_on_matrix_friendly_query(init_graph, run):
+    g = init_graph("CREATE (:A {n: 1})-[:T]->(:B)-[:T]->(:C)-[:T]->(:D)")
+    rows = run(g, "MATCH p = (:A {n: 1})-[:T*1..3]->(x) "
+                  "WHERE length(p) > 1 RETURN length(p) AS l")
+    assert sorted(r["l"] for r in rows) == [2, 3]
+
+
+def test_unwind_path_nodes_rehydrates_entities(init_graph, run):
+    g = init_graph("CREATE (:A {n: 1})-[:T]->(:B {n: 2})")
+    rows = run(g, "MATCH p = (:A)-[:T]->(b) UNWIND nodes(p) AS x "
+                  "RETURN x.n AS n")
+    assert sorted(r["n"] for r in rows) == [1, 2]
+
+
+def test_unwind_path_relationships_rehydrates(init_graph, run):
+    g = init_graph("CREATE (:A)-[:T {w: 7}]->(:B)")
+    rows = run(g, "MATCH p = (:A)-[:T]->(b) UNWIND relationships(p) AS r "
+                  "RETURN type(r) AS t, r.w AS w")
+    assert rows == [{"t": "T", "w": 7}]
+
+
+def test_distinct_and_count_on_paths(init_graph, run):
+    g = init_graph("CREATE (a:A)-[:T]->(:B), (a)-[:T]->(:B)")
+    rows = run(g, "MATCH p = (:A)-[:T]->(b) RETURN DISTINCT p")
+    assert len(rows) == 2
+    rows = run(g, "MATCH p = (:A)-[:T]->(b) RETURN p, count(*) AS c")
+    assert sorted(r["c"] for r in rows) == [1, 1]
+
+
+def test_multiple_paths_one_match(init_graph, run):
+    g = init_graph("CREATE (a:A)-[:T]->(b:B), (b)-[:S]->(:C)")
+    rows = run(g, "MATCH p = (a:A)-[:T]->(b), q = (b)-[:S]->(c) "
+                  "RETURN length(p) AS lp, length(q) AS lq")
+    assert rows == [{"lp": 1, "lq": 1}]
+
+
+def test_paths_in_collect(init_graph, run):
+    g = init_graph("CREATE (:A)-[:T]->(:B)-[:T]->(:C)")
+    rows = run(g, "MATCH p = (:A)-[:T*1..2]->(x) "
+                  "RETURN length(p) AS l ORDER BY l")
+    assert [r["l"] for r in rows] == [1, 2]
+
+
+def test_count_path_null_witness(init_graph, run):
+    """count(p) counts non-null paths; the witness column must be one the
+    OPTIONAL MATCH itself binds (the first hop's rel), since the start
+    node can be bound outside and stays non-null on a failed match."""
+    g = init_graph("CREATE (a:A {n: 1})-[:T]->(b:B {n: 2})")
+    cases = [
+        ("MATCH (x:A) OPTIONAL MATCH p = (x)-[:T]->(y) "
+         "RETURN count(p) AS c", 1),
+        ("MATCH (x:B) OPTIONAL MATCH p = (x)-[:T]->(y) "
+         "RETURN count(p) AS c", 0),
+        ("MATCH (x:B) OPTIONAL MATCH p = (x)-[:T*1..2]->(y) "
+         "RETURN count(p) AS c", 0),
+        ("MATCH (x:B) OPTIONAL MATCH p = (x)-[:T]->(y) WITH p "
+         "RETURN count(p) AS c", 0),
+        ("OPTIONAL MATCH p = (x:Zed) RETURN count(p) AS c", 0),
+    ]
+    for q, want in cases:
+        assert run(g, q) == [{"c": want}], q
+
+
+def test_aggregating_path_value_raises(init_graph, run):
+    import pytest
+    from caps_tpu.ir.builder import IRBuildError
+    g = init_graph("CREATE (:A)-[:T]->(:B)")
+    with pytest.raises(IRBuildError):
+        run(g, "MATCH p = (:A)-[:T]->(b) RETURN collect(p) AS c")
+
+
+def test_unwind_list_with_null_keeps_null_row(init_graph, run):
+    """UNWIND of an entity list containing null keeps the null row on
+    every backend (the rehydration left-join must retain null-key rows)."""
+    g = init_graph("CREATE (:A {n: 1})")
+    rows = run(g, "MATCH (a:A) WITH [a, null] AS l UNWIND l AS x "
+                  "RETURN x.n AS n")
+    assert sorted(rows, key=str) == [{"n": 1}, {"n": None}]
+
+
+def test_path_equality_and_null_checks(init_graph, run):
+    """p = q compares start node + relationship id sequence; IS NULL uses
+    the first hop's binding as witness."""
+    g = init_graph("CREATE (a:A)-[:T]->(b:B), (a)-[:S]->(b)")
+    rows = run(g, "MATCH p = (:A)-[:T]->(x) MATCH q = (:A)-[:T]->(y) "
+                  "RETURN p = q AS eq")
+    assert rows == [{"eq": True}]
+    rows = run(g, "MATCH p = (:A)-[:T]->(x) MATCH q = (:A)-[:S]->(y) "
+                  "RETURN p = q AS eq, p <> q AS ne")
+    assert rows == [{"eq": False, "ne": True}]
+    rows = run(g, "MATCH (x:B) OPTIONAL MATCH p = (x)-[:T]->(y) "
+                  "RETURN p IS NULL AS isn")
+    assert rows == [{"isn": True}]
+
+
+def test_projected_path_equality_and_reuse_guard(init_graph, run):
+    import pytest
+    from caps_tpu.ir.builder import IRBuildError
+    g = init_graph("CREATE (a:A {n: 1})-[:T {w: 5}]->(b:B), (a)-[:S]->(b)")
+    rows = run(g, "MATCH p = (:A)-[:T]->(x) MATCH q = (:A)-[:T]->(y) "
+                  "WITH p, q RETURN p = q AS eq")
+    assert rows == [{"eq": True}]
+    with pytest.raises(IRBuildError):
+        run(g, "MATCH p = (a:A)-[:T]->(b) MATCH (p) RETURN p")
+
+
+def test_indexing_into_path_decomposition(init_graph, run):
+    """nodes(p)[i] / relationships(p)[i] materialize full entities via the
+    graph lookup even though the indexed value is a bare id column."""
+    g = init_graph("CREATE (:A {n: 1})-[:T {w: 5}]->(:B {n: 2})")
+    rows = run(g, "MATCH p = (:A)-[:T]->(x) RETURN nodes(p)[0] AS h")
+    assert rows[0]["h"].labels == ("A",) and rows[0]["h"].properties == {"n": 1}
+    rows = run(g, "MATCH p = (:A)-[:T]->(x) RETURN relationships(p)[0] AS r")
+    assert rows[0]["r"].rel_type == "T" and rows[0]["r"].properties == {"w": 5}

@@ -1,0 +1,120 @@
+"""Hand-scheduled distributed joins (parallel/dist_join.py): radix
+all_to_all exchange, hot-key salting, broadcast join — parity against the
+local oracle on the 8-virtual-device CPU mesh, plus strategy-selection
+and ICI-accounting checks (SURVEY.md §5.8; round-4 VERDICT item 4)."""
+import numpy as np
+import pytest
+
+from caps_tpu.backends.local.session import LocalCypherSession
+from caps_tpu.backends.tpu.session import TPUCypherSession
+from caps_tpu.okapi.config import EngineConfig
+from caps_tpu.testing.bag import Bag
+
+from util import make_graph
+
+
+def _build(session, n=400, m=1500, seed=5, hot_frac=0.0):
+    rng = np.random.RandomState(seed)
+    src = rng.randint(0, n, m)
+    dst = rng.randint(0, n, m)
+    if hot_frac:
+        # power-law-ish: a fraction of edges all hit node 0 (hot key)
+        hot = rng.rand(m) < hot_frac
+        dst = np.where(hot, 0, dst)
+    return make_graph(
+        session,
+        {("P",): [{"_id": i, "v": int(rng.randint(0, 40))} for i in range(n)]},
+        {"T": [(int(s), int(d), {"w": int(rng.randint(0, 3))})
+               for s, d in zip(src, dst)]})
+
+
+QUERIES = [
+    "MATCH (a:P)-[r:T]->(b:P) WHERE a.v = 7 "
+    "RETURN b.v AS v, count(*) AS c ORDER BY v",
+    "MATCH (a:P {v: 3})-[r:T]->(b:P) RETURN r.w AS w, b.v AS v",
+    "MATCH (a:P) OPTIONAL MATCH (a)-[r:T]->(b:P {v: 9}) "
+    "RETURN a.v AS av, count(r) AS c ORDER BY av",
+]
+
+
+@pytest.fixture(scope="module")
+def oracle_results():
+    s = LocalCypherSession()
+    g = _build(s)
+    return [g.cypher(q).records.to_maps() for q in QUERIES]
+
+
+def _run_config(cfg, oracle_results, expect_strategy):
+    s = TPUCypherSession(config=cfg)
+    g = _build(s)
+    fired = 0
+    for q, want in zip(QUERIES, oracle_results):
+        res = g.cypher(q)
+        got = res.records.to_maps()
+        assert Bag(got) == want, (q, got[:5], want[:5])
+        fired += res.metrics[expect_strategy]
+        if res.metrics[expect_strategy]:
+            assert res.metrics["ici_bytes"] > 0
+    assert s.fallback_count == 0, s.backend.fallback_reasons
+    assert fired > 0, f"{expect_strategy} never fired"
+
+
+def test_radix_exchange_join_parity(oracle_results):
+    _run_config(EngineConfig(mesh_shape=(8,), use_csr=False,
+                             broadcast_join_threshold=0),
+                oracle_results, "dist_joins")
+
+
+def test_radix_salted_join_parity(oracle_results):
+    _run_config(EngineConfig(mesh_shape=(8,), use_csr=False,
+                             broadcast_join_threshold=0, join_salt=4),
+                oracle_results, "dist_joins")
+
+
+def test_broadcast_join_parity(oracle_results):
+    _run_config(EngineConfig(mesh_shape=(8,), use_csr=False,
+                             broadcast_join_threshold=1 << 20),
+                oracle_results, "broadcast_joins")
+
+
+def test_skewed_key_parity_with_salt():
+    """A hot destination key (power-law guard): salted radix join must
+    match the oracle exactly — build rows replicate into every sub-bucket,
+    probe rows round-robin across them."""
+    s0 = LocalCypherSession()
+    g0 = _build(s0, hot_frac=0.4, seed=11)
+    q = ("MATCH (a:P)-[r:T]->(b:P) WHERE b.v < 5 "
+         "RETURN a.v AS av, b.v AS bv, r.w AS w")
+    want = g0.cypher(q).records.to_maps()
+    s = TPUCypherSession(config=EngineConfig(
+        mesh_shape=(8,), use_csr=False, broadcast_join_threshold=0,
+        join_salt=4))
+    g = _build(s, hot_frac=0.4, seed=11)
+    res = g.cypher(q)
+    assert Bag(res.records.to_maps()) == want
+    assert res.metrics["dist_joins"] > 0
+    assert s.fallback_count == 0, s.backend.fallback_reasons
+
+
+def test_radix_beats_broadcast_on_ici_bytes(oracle_results):
+    """The point of the exchange: each row crosses ICI once, vs once per
+    device for all_gather — the static accounting must show it."""
+    q = QUERIES[1]
+    bytes_by = {}
+    for name, thresh in (("radix", 0), ("broadcast", 1 << 20)):
+        s = TPUCypherSession(config=EngineConfig(
+            mesh_shape=(8,), use_csr=False,
+            broadcast_join_threshold=thresh))
+        g = _build(s)
+        res = g.cypher(q)
+        bytes_by[name] = res.metrics["ici_bytes"]
+    assert 0 < bytes_by["radix"] < bytes_by["broadcast"], bytes_by
+
+
+def test_single_chip_unaffected():
+    """No mesh → the dist-join path must stand down (returns None)."""
+    s = TPUCypherSession()
+    g = _build(s, n=100, m=300)
+    res = g.cypher(QUERIES[0])
+    assert res.metrics["dist_joins"] == 0
+    assert res.metrics["broadcast_joins"] == 0

@@ -152,9 +152,27 @@ def test_rebinding_rel_var_fails():
         build("MATCH (a)-[r]->(b)-[r]->(c) RETURN a")
 
 
-def test_named_path_unsupported():
+def test_named_path_builds_path_expr():
+    q = build("MATCH p = (a)-[:X]->(b) RETURN p")
+    from caps_tpu.ir import exprs as E
+    from caps_tpu.ir.blocks import ProjectBlock
+    proj = [b for b in q.blocks if isinstance(b, ProjectBlock)][0]
+    (name, expr), = proj.items
+    assert name == "p"
+    assert isinstance(expr, E.PathExpr)
+    assert expr.nodes == (E.Var("a"), E.Var("b"))
+    assert expr.rels == (E.Var("__rel1"),)
+    assert expr.varlen == (False,)
+
+
+def test_named_path_rebinding_refused():
     with pytest.raises(IRBuildError):
-        build("MATCH p = (a)-[:X]->(b) RETURN p")
+        build("MATCH p = (a)-[:X]->(b) MATCH p = (c)-[:X]->(d) RETURN p")
+
+
+def test_named_path_nodes_on_varlen_refused():
+    with pytest.raises(IRBuildError):
+        build("MATCH p = (a)-[:X*1..2]->(b) RETURN nodes(p)")
 
 
 # -- typer ------------------------------------------------------------------
