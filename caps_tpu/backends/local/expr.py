@@ -9,9 +9,10 @@ from __future__ import annotations
 
 import math
 import re
-from typing import Any, Callable, Dict, List, Mapping, Optional
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 from caps_tpu.ir import exprs as E
+from caps_tpu.okapi.types import _CTList, _CTNode, _CTRelationship
 from caps_tpu.okapi.values import cypher_equals, cypher_lt
 from caps_tpu.relational.header import RecordHeader
 
@@ -29,13 +30,34 @@ def evaluate(expr: E.Expr, n_rows: int, getcol: GetCol, header: RecordHeader,
     return ev.eval(expr)
 
 
+def _kind_of_type(t) -> Optional[str]:
+    m = t.material
+    if isinstance(m, _CTNode):
+        return "node"
+    if isinstance(m, _CTRelationship):
+        return "rel"
+    return None
+
+
+def _kind_at(kinds, idx: int) -> Optional[str]:
+    """Entity kind for list position ``idx`` given a uniform kind or a
+    per-position kind list (see _Evaluator._elem_kind)."""
+    if isinstance(kinds, list):
+        return kinds[idx] if idx < len(kinds) else None
+    return kinds
+
+
 class _Evaluator:
     def __init__(self, n: int, getcol: GetCol, header: RecordHeader,
-                 params: Mapping[str, Any]):
+                 params: Mapping[str, Any], entity_ctx=None):
         self.n = n
         self.getcol = getcol
         self.header = header
         self.params = dict(params)
+        # host-side entity rehydration (relational/ops.py EntityContext),
+        # threaded via the reserved parameter key
+        from caps_tpu.relational.ops import ENTITY_CTX_PARAM
+        self.entity_ctx = self.params.pop(ENTITY_CTX_PARAM, entity_ctx)
 
     def const(self, v: Any) -> List[Any]:
         return [v] * self.n
@@ -221,6 +243,7 @@ class _Evaluator:
             return out
         if isinstance(e, E.ListComprehension):
             lists = self.eval(e.list_expr)
+            kind = self._elem_kind(e.list_expr)
             out = []
             for i, lst in enumerate(lists):
                 if lst is None:
@@ -228,9 +251,9 @@ class _Evaluator:
                     continue
                 row_getcol = _row_slice(self.getcol, i)
                 acc = []
-                for item in lst:
-                    sub = _BoundEvaluator(1, row_getcol, self.header,
-                                          self.params, {e.var: [item]})
+                for idx, item in enumerate(lst):
+                    sub = self._bind(row_getcol, e.var, item,
+                                     _kind_at(kind, idx))
                     if e.predicate is not None \
                             and sub.eval(e.predicate)[0] is not True:
                         continue
@@ -238,6 +261,40 @@ class _Evaluator:
                                if e.projection is not None else item)
                 out.append(acc)
             return out
+        if isinstance(e, E.QuantifiedPredicate):
+            lists = self.eval(e.list_expr)
+            kind = self._elem_kind(e.list_expr)
+            out = []
+            for i, lst in enumerate(lists):
+                if lst is None:
+                    out.append(None)
+                    continue
+                row_getcol = _row_slice(self.getcol, i)
+                verdicts = [
+                    self._bind(row_getcol, e.var, item, _kind_at(kind, idx))
+                    .eval(e.predicate)[0] for idx, item in enumerate(lst)]
+                out.append(_quantify(e.kind, verdicts))
+            return out
+        if isinstance(e, E.Reduce):
+            lists = self.eval(e.list_expr)
+            inits = self.eval(e.init)
+            kind = self._elem_kind(e.list_expr)
+            out = []
+            for i, lst in enumerate(lists):
+                if lst is None:
+                    out.append(None)
+                    continue
+                row_getcol = _row_slice(self.getcol, i)
+                acc_v = inits[i]
+                for idx, item in enumerate(lst):
+                    sub = self._bind(row_getcol, e.var, item,
+                                     _kind_at(kind, idx),
+                                     extra2=(e.acc, acc_v))
+                    acc_v = sub.eval(e.expr)[0]
+                out.append(acc_v)
+            return out
+        if isinstance(e, E.PathNodes):
+            return self._path_nodes(e)
 
         if isinstance(e, E.CaseExpr):
             conds = [self.eval(c) for c in e.conditions]
@@ -279,6 +336,98 @@ class _Evaluator:
         raise ExprEvalError(f"cannot evaluate {type(e).__name__}: {e!r}")
 
     # -- helpers ------------------------------------------------------------
+
+    def _bind(self, row_getcol: GetCol, var: str, item: Any,
+              kind: Optional[str],
+              extra2: Optional[Tuple[str, Any]] = None) -> "_BoundEvaluator":
+        extra = {var: [item]}
+        kinds = {var: kind} if kind is not None else {}
+        if extra2 is not None:
+            extra[extra2[0]] = [extra2[1]]
+        return _BoundEvaluator(1, row_getcol, self.header, self.params,
+                               extra, entity_kinds=kinds,
+                               entity_ctx=self.entity_ctx)
+
+    def _single_kind(self, item: E.Expr) -> Optional[str]:
+        """'node' | 'rel' | None: static entity kind of a scalar expr."""
+        if isinstance(item, E.PathNode):
+            return "node"
+        if isinstance(item, E.PathSeg):
+            return None if item.is_varlen else "rel"
+        if isinstance(item, (E.StartNode, E.EndNode)):
+            return "node"
+        if self.header.has(item):
+            return _kind_of_type(self.header.type_of(item))
+        return None
+
+    def _elem_kind(self, le: E.Expr):
+        """Static entity kind(s) of a list-valued expr, so comprehension /
+        quantifier variables ranging over entity ids can rehydrate
+        properties and labels.  Returns ``'node'`` / ``'rel'`` (uniform),
+        a per-position LIST of kinds (list literals — mixed elements must
+        not coerce plain integers into entity ids), or ``None``."""
+        if isinstance(le, E.ListLit):
+            kinds = [self._single_kind(i) for i in le.items]
+            uniq = set(kinds)
+            if len(uniq) == 1:
+                return kinds[0]
+            return kinds
+        if isinstance(le, E.Add):
+            lk, rk = self._elem_kind(le.lhs), self._elem_kind(le.rhs)
+            if isinstance(lk, list) and isinstance(rk, list):
+                return lk + rk  # concat of two literals: positions align
+            if lk == rk:
+                return lk  # uniform (possibly None) on both sides
+            # literal + uniform of unknown length: positions can't align
+            return None
+        if isinstance(le, E.PathNodes):
+            return "node"
+        if isinstance(le, E.PathSeg) and le.is_varlen:
+            return "rel"
+        if isinstance(le, E.Slice):
+            k = self._elem_kind(le.expr)
+            return k if not isinstance(k, list) else None
+        if isinstance(le, E.FunctionExpr) and le.name == "tail" and le.args:
+            k = self._elem_kind(le.args[0])
+            return k if not isinstance(k, list) else None
+        if isinstance(le, E.Collect):
+            return self._single_kind(le.expr) or self._elem_kind(le.expr)
+        if self.header.has(le):
+            t = self.header.type_of(le).material
+            if isinstance(t, _CTList):
+                return _kind_of_type(t.inner)
+        return None
+
+    def _path_nodes(self, e: "E.PathNodes") -> List[Any]:
+        """Walk each hop's relationship endpoints to rebuild the node-id
+        sequence (mirrors relational/session.py _materialize_paths)."""
+        starts = self.eval(e.start)
+        piece_cols = [self.eval(p) for p in e.pieces]
+        ctx = self.entity_ctx
+        out: List[Any] = []
+        for i in range(self.n):
+            cur = starts[i]
+            if cur is None:
+                out.append(None)
+                continue
+            nodes = [cur]
+            dead = False
+            for j, col in enumerate(piece_cols):
+                cell = col[i]
+                if cell is None:
+                    dead = True  # null hop (optional path): whole value null
+                    break
+                for rid in (cell if e.is_list[j] else [cell]):
+                    rec = ctx.rel(rid) if ctx is not None else None
+                    if rec is None:
+                        raise ExprEvalError(
+                            f"nodes(<path>): relationship {rid} not found in "
+                            "the current graph (no entity context)")
+                    src, tgt, _typ, _props = rec
+                    cur = tgt if src == cur else src
+                    nodes.append(cur)
+            out.append(None if dead else nodes)
+        return out
 
     def _cmp(self, e, fn) -> List[Any]:
         l, r = self.eval(e.lhs), self.eval(e.rhs)
@@ -338,17 +487,120 @@ class _Evaluator:
 
 
 class _BoundEvaluator(_Evaluator):
-    """Evaluator with extra column bindings (list-comprehension variables)."""
+    """Evaluator with extra column bindings (list-comprehension /
+    quantifier / reduce variables).  When a bound variable ranges over
+    entity ids (``entity_kinds``), property / label / endpoint access on
+    it rehydrates through the entity context — intercepted BEFORE the
+    header lookup so the lambda variable shadows any same-named header
+    column (Cypher scoping)."""
 
     def __init__(self, n: int, getcol: GetCol, header: RecordHeader,
-                 params: Mapping[str, Any], extra: Dict[str, List[Any]]):
-        super().__init__(n, getcol, header, params)
+                 params: Mapping[str, Any], extra: Dict[str, List[Any]],
+                 entity_kinds: Optional[Dict[str, str]] = None,
+                 entity_ctx=None):
+        super().__init__(n, getcol, header, params, entity_ctx=entity_ctx)
         self.extra = extra
+        self.entity_kinds = entity_kinds or {}
 
     def eval(self, e: E.Expr) -> List[Any]:
         if isinstance(e, E.Var) and e.name in self.extra:
             return self.extra[e.name]
+        hit = self._bound_access(e)
+        if hit is not None:
+            return hit
         return super().eval(e)
+
+    def _bind(self, row_getcol: GetCol, var: str, item: Any,
+              kind: Optional[str],
+              extra2: Optional[Tuple[str, Any]] = None) -> "_BoundEvaluator":
+        sub = super()._bind(row_getcol, var, item, kind, extra2)
+        # nested scopes still see the enclosing bound variables
+        for k, v in self.extra.items():
+            sub.extra.setdefault(k, v)
+        for k, v in self.entity_kinds.items():
+            sub.entity_kinds.setdefault(k, v)
+        return sub
+
+    def _bound_access(self, e: E.Expr) -> Optional[List[Any]]:
+        if isinstance(e, (E.Property, E.Keys, E.Properties)):
+            tgt = e.entity
+        elif isinstance(e, (E.Labels, E.HasLabel)):
+            tgt = e.node
+        elif isinstance(e, (E.Type, E.HasType, E.StartNode, E.EndNode)):
+            tgt = e.rel
+        else:
+            return None
+        if not (isinstance(tgt, E.Var) and tgt.name in self.extra):
+            return None
+        kind = self.entity_kinds.get(tgt.name)
+        return [self._entity_field(e, v, kind) for v in self.extra[tgt.name]]
+
+    def _entity_field(self, e: E.Expr, v: Any, kind: Optional[str]) -> Any:
+        if v is None:
+            return None
+        if isinstance(v, dict):  # map values bound to the variable
+            if isinstance(e, E.Property):
+                return v.get(e.key)
+            if isinstance(e, E.Keys):
+                return sorted(v.keys())
+            if isinstance(e, E.Properties):
+                return dict(v)
+            return None
+        ctx = self.entity_ctx
+        if kind is None or ctx is None or isinstance(v, bool) \
+                or not isinstance(v, int):
+            return None  # non-entity element: lenient null (engine-wide)
+        if kind == "node":
+            rec = ctx.node(v)
+            labels, props = rec if rec is not None else ((), {})
+            if isinstance(e, E.Property):
+                return props.get(e.key)
+            if isinstance(e, E.Labels):
+                return [lbl for lbl in sorted(labels)]
+            if isinstance(e, E.HasLabel):
+                return e.label in labels
+            if isinstance(e, E.Keys):
+                return sorted(k for k, p in props.items() if p is not None)
+            if isinstance(e, E.Properties):
+                return {k: p for k, p in props.items() if p is not None}
+            return None
+        rec = ctx.rel(v)
+        src, tgt, typ, props = rec if rec is not None else (None, None, None, {})
+        if isinstance(e, E.Property):
+            return props.get(e.key)
+        if isinstance(e, E.Type):
+            return typ
+        if isinstance(e, E.HasType):
+            return typ == e.rel_type
+        if isinstance(e, E.StartNode):
+            return src
+        if isinstance(e, E.EndNode):
+            return tgt
+        if isinstance(e, E.Keys):
+            return sorted(k for k, p in props.items() if p is not None)
+        if isinstance(e, E.Properties):
+            return {k: p for k, p in props.items() if p is not None}
+        return None
+
+
+def _quantify(kind: str, verdicts: List[Any]) -> Optional[bool]:
+    """openCypher 3VL for all/any/none/single over a predicate's verdicts."""
+    n_true = sum(1 for v in verdicts if v is True)
+    n_null = sum(1 for v in verdicts if v is not True and v is not False)
+    if kind == "any":
+        return True if n_true else (None if n_null else False)
+    if kind == "all":
+        if any(v is False for v in verdicts):
+            return False
+        return None if n_null else True
+    if kind == "none":
+        return False if n_true else (None if n_null else True)
+    # single: exactly one element satisfies
+    if n_true > 1:
+        return False
+    if n_null:
+        return None
+    return n_true == 1
 
 
 def _row_slice(getcol: GetCol, row: int) -> GetCol:

@@ -65,10 +65,12 @@ def _reprable(v: Any) -> bool:
 
 
 def _params_key(params: Mapping[str, Any]) -> Optional[str]:
+    from caps_tpu.relational.ops import ENTITY_CTX_PARAM
     try:
-        if not all(_reprable(v) for v in params.values()):
+        items = [(k, v) for k, v in params.items() if k != ENTITY_CTX_PARAM]
+        if not all(_reprable(v) for _, v in items):
             return None
-        return repr(sorted(params.items()))
+        return repr(sorted(items))
     except Exception:
         return None  # unorderable/unhashable params: skip fusion
 

@@ -591,6 +591,9 @@ class CypherParser:
     def _parse_function_call(self, name: str) -> E.Expr:
         """After `name(`."""
         lname = name.lower()
+        if lname in ("all", "any", "none", "single", "filter", "extract",
+                     "reduce"):
+            return self._parse_iterable_call(lname)
         distinct = self.accept_kw("DISTINCT")
         args: List[E.Expr] = []
         if self.at_sym("*") and lname == "count":
@@ -627,6 +630,38 @@ class CypherParser:
         if lname == "properties":
             return E.Properties(args[0])
         return E.FunctionExpr(lname, tuple(args))
+
+    def _parse_iterable_call(self, lname: str) -> E.Expr:
+        """After `all(`/`any(`/`none(`/`single(`/`filter(`/`extract(`/
+        `reduce(`: the iterable-predicate forms ``f(var IN list WHERE p)``
+        and ``reduce(acc = init, var IN list | expr)``."""
+        if lname == "reduce":
+            acc = self.ident_like("accumulator")
+            self.expect_sym("=")
+            init = self.parse_expr()
+            self.expect_sym(",")
+            var = self.ident_like("variable")
+            self.expect_kw("IN")
+            list_expr = self._parse_or()
+            self.expect_sym("|")
+            expr = self.parse_expr()
+            self.expect_sym(")")
+            return E.Reduce(acc, init, var, list_expr, expr)
+        var = self.ident_like("variable")
+        self.expect_kw("IN")
+        list_expr = self._parse_or()
+        predicate = self.parse_expr() if self.accept_kw("WHERE") else None
+        projection = None
+        if lname == "extract" and self.accept_sym("|"):
+            projection = self.parse_expr()
+        self.expect_sym(")")
+        if lname == "extract":
+            return E.ListComprehension(var, list_expr, predicate, projection)
+        if predicate is None:
+            raise self.error(f"{lname}(...) requires a WHERE predicate")
+        if lname == "filter":
+            return E.ListComprehension(var, list_expr, predicate, None)
+        return E.QuantifiedPredicate(lname, var, list_expr, predicate)
 
     def _make_aggregator(self, lname: str, args: List[E.Expr], distinct: bool) -> E.Expr:
         def one() -> E.Expr:

@@ -49,12 +49,15 @@ def _pattern_vars(pattern: ast.Pattern) -> Set[str]:
 
 def _check_expr_vars(expr: E.Expr, scope: Set[str], where: str) -> None:
     local = set()
-    # comprehension vars first: they are visible anywhere in this expr
+    # binder vars first: they are visible anywhere in this expr
     for n in expr.walk():
         if isinstance(n, E.ExistsSubQuery):
             continue  # its own scope — checked recursively below
-        if isinstance(n, E.ListComprehension):
+        if isinstance(n, (E.ListComprehension, E.QuantifiedPredicate)):
             local.add(n.var)
+        elif isinstance(n, E.Reduce):
+            local.add(n.var)
+            local.add(n.acc)
 
     def check(n: E.Expr) -> None:
         if isinstance(n, E.ExistsSubQuery):

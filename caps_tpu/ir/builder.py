@@ -339,10 +339,15 @@ class _SingleQueryBuilder:
                     return rels_expr(p)
                 if fname == "nodes":
                     if any(d.varlen):
-                        raise IRBuildError(
-                            "nodes() on a variable-length named path is not "
-                            "supported (interior nodes are unbound); use "
-                            "relationships() or length()")
+                        # Interior nodes of var-length segments are unbound
+                        # vars, but the hop rel ids are — reconstruct the
+                        # node sequence at eval time by walking endpoints
+                        # (same machinery as path materialization).
+                        return E.PathNodes(
+                            start_id_expr(p),
+                            tuple(self._path_rel_piece(d, p, i)
+                                  for i in range(k)),
+                            d.varlen)
                     if d.projected:
                         return E.ListLit(tuple(
                             E.PathNode(E.Var(p), i) for i in range(k + 1)))

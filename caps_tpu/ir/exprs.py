@@ -178,6 +178,21 @@ class PathNode(Expr):
     index: int
 
 
+@dataclasses.dataclass(frozen=True)
+class PathNodes(Expr):
+    """Node-id sequence of a (possibly var-length) named path,
+    reconstructed at evaluation time by walking each hop's relationship
+    endpoints — the expression form of the var-length path
+    materialization in ``relational/session.py``.  ``pieces[i]`` yields
+    hop ``i``'s relationship id (or rel-id list when ``is_list[i]``)."""
+    start: Expr
+    pieces: Tuple[Expr, ...]
+    is_list: Tuple[bool, ...]
+
+    def cypher_repr(self) -> str:
+        return "nodes(<path>)"
+
+
 # -- boolean (3-valued) -----------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)
@@ -390,6 +405,44 @@ class ListComprehension(Expr):
     predicate: Optional[Expr]
     projection: Optional[Expr]
 
+    def cypher_repr(self) -> str:
+        out = f"[{self.var} IN {self.list_expr.cypher_repr()}"
+        if self.predicate is not None:
+            out += f" WHERE {self.predicate.cypher_repr()}"
+        if self.projection is not None:
+            out += f" | {self.projection.cypher_repr()}"
+        return out + "]"
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantifiedPredicate(Expr):
+    """``all/any/none/single(var IN list WHERE pred)`` with openCypher
+    3-valued semantics (ref: front-end ``IterablePredicateExpression``
+    family — reconstructed, mount empty; SURVEY.md §2 "Cypher front-end")."""
+    kind: str  # 'all' | 'any' | 'none' | 'single'
+    var: str
+    list_expr: Expr
+    predicate: Expr
+
+    def cypher_repr(self) -> str:
+        return (f"{self.kind}({self.var} IN {self.list_expr.cypher_repr()} "
+                f"WHERE {self.predicate.cypher_repr()})")
+
+
+@dataclasses.dataclass(frozen=True)
+class Reduce(Expr):
+    """``reduce(acc = init, var IN list | expr)``."""
+    acc: str
+    init: Expr
+    var: str
+    list_expr: Expr
+    expr: Expr
+
+    def cypher_repr(self) -> str:
+        return (f"reduce({self.acc} = {self.init.cypher_repr()}, {self.var} "
+                f"IN {self.list_expr.cypher_repr()} | "
+                f"{self.expr.cypher_repr()})")
+
 
 # -- case -------------------------------------------------------------------
 
@@ -504,22 +557,44 @@ def is_aggregating(e: Expr) -> bool:
 def vars_in(e: Expr) -> Tuple[Var, ...]:
     """Free variables of ``e`` at its own scope level.  An EXISTS subquery
     contributes the outer vars its pattern binds against plus any outer
-    vars in its predicates — but not its pattern-local variables."""
+    vars in its predicates — but not its pattern-local variables.
+    Variables bound by list comprehensions, quantified predicates, and
+    ``reduce`` are likewise excluded inside their own scopes."""
     seen: list = []
 
     def add(v: Var) -> None:
         if v not in seen:
             seen.append(v)
 
-    def go(n) -> None:
+    def go(n, bound: frozenset) -> None:
         if isinstance(n, ExistsSubQuery):
             for name in n.outer_free_vars():
-                add(Var(name))
+                if name not in bound:
+                    add(Var(name))
             return
         if isinstance(n, Var):
-            add(n)
+            if n.name not in bound:
+                add(n)
+            return
+        if isinstance(n, ListComprehension):
+            go(n.list_expr, bound)
+            inner = bound | {n.var}
+            if n.predicate is not None:
+                go(n.predicate, inner)
+            if n.projection is not None:
+                go(n.projection, inner)
+            return
+        if isinstance(n, QuantifiedPredicate):
+            go(n.list_expr, bound)
+            go(n.predicate, bound | {n.var})
+            return
+        if isinstance(n, Reduce):
+            go(n.init, bound)
+            go(n.list_expr, bound)
+            go(n.expr, bound | {n.acc, n.var})
+            return
         for c in n.children:
-            go(c)
+            go(c, bound)
 
-    go(e)
+    go(e, frozenset())
     return tuple(seen)

@@ -160,6 +160,29 @@ class SchemaTyper:
             if e.projection is not None:
                 return CTList(self.type_of(e.projection, env2))
             return CTList(inner)
+        if isinstance(e, E.QuantifiedPredicate):
+            lt = rec(e.list_expr).material
+            inner = lt.inner if isinstance(lt, _CTList) else CTAny
+            env2 = dict(env)
+            env2[e.var] = inner
+            self.type_of(e.predicate, env2)  # scope/arity validation
+            return CTBoolean.nullable
+        if isinstance(e, E.Reduce):
+            lt = rec(e.list_expr).material
+            env2 = dict(env)
+            env2[e.var] = lt.inner if isinstance(lt, _CTList) else CTAny
+            acc_t = rec(e.init)
+            env2[e.acc] = acc_t
+            step_t = self.type_of(e.expr, env2)
+            # one widening pass: the accumulator's steady-state type is the
+            # join of init and one step's result
+            env2[e.acc] = acc_t.join(step_t)
+            return acc_t.join(self.type_of(e.expr, env2))
+        if isinstance(e, E.PathNodes):
+            from caps_tpu.okapi.types import CTNode
+            t = rec(e.start)
+            out = CTList(CTNode())
+            return out.nullable if t.is_nullable else out
 
         if isinstance(e, E.CaseExpr):
             branches = [rec(v) for v in e.values]

@@ -140,10 +140,12 @@ def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
     if not isinstance(agg, E.CountStar):
         return None
 
-    hops_rev: List[Tuple[str, Tuple[str, ...], Direction, str, frozenset]] = []
+    hops_rev: List[Tuple[str, Tuple[str, ...], Direction, str, frozenset,
+                         str]] = []
     preds_by_var: Dict[str, List[E.Expr]] = {}
     uniq_pairs: List[Tuple[str, str]] = []
     varlen: Opt[L.BoundedVarLengthExpand] = None
+    closing: Opt[L.Expand] = None
     pending: List[E.Expr] = []
 
     cur = op.parent
@@ -153,14 +155,20 @@ def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
             pending.extend(_split(cur.predicate))
             cur = cur.parent
         elif isinstance(cur, L.Expand):
-            if cur.into or cur.direction == Direction.BOTH or varlen:
+            if cur.direction == Direction.BOTH or varlen:
                 return None
-            hops_rev.append((cur.rel, cur.rel_types, cur.direction,
-                             cur.target, cur.target_labels))
+            if cur.into:
+                # at most one cycle-closing edge (both endpoints bound)
+                if closing is not None:
+                    return None
+                closing = cur
+            else:
+                hops_rev.append((cur.rel, cur.rel_types, cur.direction,
+                                 cur.target, cur.target_labels, cur.source))
             cur = cur.parent
         elif isinstance(cur, L.BoundedVarLengthExpand):
             if (cur.into or cur.direction == Direction.BOTH or hops_rev
-                    or varlen or cur.upper is None or cur.upper > 3):
+                    or varlen or closing or cur.upper is None or cur.upper > 3):
                 return None
             varlen = cur
             cur = cur.parent
@@ -170,6 +178,24 @@ def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
             seed = (cur.var, cur.labels)
         else:
             return None
+
+    # The walk collected Expands in plan order; the SpMV/cycle lowerings
+    # assume a CHAIN — every hop must expand from the previous hop's
+    # target (first hop: from the seed).  A star pattern like
+    # (a)->(b), (a)->(c) also type-checks as 2 hops over 3 node vars but
+    # is NOT a chain; counting it as one is silently wrong.
+    if hops_rev:
+        expected_src = seed[0]
+        for r, t, d, tv, tl, src in reversed(hops_rev):
+            if src != expected_src:
+                return None
+            expected_src = tv
+
+    if closing is not None and varlen is None:
+        return _plan_cycle(planner, op, fallback, seed, hops_rev, closing,
+                           pending, out_name)
+    if closing is not None:
+        return None
 
     if varlen is not None:
         node_vars = {seed[0], varlen.target}
@@ -219,7 +245,7 @@ def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
         # hop output is masked by node existence (+labels/preds).  The
         # uniqueness filters the IR emitted map to hop-position pairs.
         hops = [HopSpec(r, tuple(t), d, node_spec(tv, tl))
-                for r, t, d, tv, tl in reversed(hops_rev)]
+                for r, t, d, tv, tl, _src in reversed(hops_rev)]
         if uniq_pairs and max_len < 2:
             return None
         pos_of = {h.rel: i + 1 for i, h in enumerate(hops)}
@@ -230,6 +256,65 @@ def try_plan_count_pushdown(planner, op: "L.Aggregate", fallback):
     return CountPatternOp(planner.context, fallback, planner.current_graph,
                           out_name, seed_spec, hops, lengths, uniq_pos,
                           is_varlen=varlen is not None)
+
+
+def _plan_cycle(planner, op, fallback, seed, hops_rev, closing, pending,
+                out_name):
+    """Match the cyclic triangle shape: a 2-hop chain a->b->c plus one
+    closing edge between a and c (any per-edge orientation), lowered to
+    batched 2-path enumeration with a sorted closing-edge key probe
+    (benchmark config 4; ref analog: Spark plans this as a 5-way shuffle
+    join cascade — reconstructed, mount empty; SURVEY.md §3.2)."""
+    if len(hops_rev) != 2:
+        return None
+    a_var = seed[0]
+    hops_fwd = list(reversed(hops_rev))
+    b_var, c_var = hops_fwd[0][3], hops_fwd[1][3]
+    node_vars = {a_var, b_var, c_var}
+    rel_vars = {h[0] for h in hops_fwd} | {closing.rel}
+    if len(node_vars) != 3 or len(rel_vars) != 3:
+        return None
+    if {closing.source, closing.target} != {a_var, c_var}:
+        return None
+    if closing.target_labels:
+        # labels restated on the closing mention must already be implied
+        # by the var's own spec (the cycle build masks a/c once)
+        existing = seed[1] if closing.target == a_var else hops_fwd[1][4]
+        if not frozenset(closing.target_labels) <= frozenset(existing):
+            return None
+
+    preds_by_var: Dict[str, List[E.Expr]] = {}
+    for pred in pending:
+        pair = _as_uniqueness_pair(pred)
+        if pair is not None:
+            if set(pair) <= rel_vars:
+                # relationship-isomorphism filters between the three rels:
+                # enforced structurally by CountCycleOp (it refuses graphs
+                # with self-loops, the only way two cycle rels can coincide)
+                continue
+            return None
+        vs = {v.name for v in E.vars_in(pred)}
+        if len(vs) == 1 and (v := next(iter(vs))) in node_vars:
+            preds_by_var.setdefault(v, []).append(pred)
+            continue
+        return None
+
+    def spec(var: str, labels) -> NodeSpec:
+        return NodeSpec(var, frozenset(labels),
+                        tuple(preds_by_var.get(var, ())))
+
+    seed_spec = spec(a_var, seed[1])
+    hops = [HopSpec(r, tuple(t), d, spec(tv, tl))
+            for r, t, d, tv, tl, _src in hops_fwd]
+    # orient the closing edge as a->c regardless of how it was written
+    closes_forward = (closing.source == a_var) \
+        == (closing.direction == Direction.OUTGOING)
+    close_hop = HopSpec(closing.rel, tuple(closing.rel_types),
+                        Direction.OUTGOING if closes_forward
+                        else Direction.INCOMING,
+                        spec(c_var, closing.target_labels))
+    return CountCycleOp(planner.context, fallback, planner.current_graph,
+                        out_name, seed_spec, hops, close_hop)
 
 
 class CountPatternOp(RelationalOperator):
@@ -300,6 +385,8 @@ class CountPatternOp(RelationalOperator):
             self.strategy = "fallback-join"
             out = self.children[0].result
         self._metric_extra = {"strategy": self.strategy}
+        if getattr(self, "_fused_bytes", 0):
+            self._metric_extra["bytes_in"] = self._fused_bytes
         return out
 
     # -- fused single-program execution -------------------------------------
@@ -354,6 +441,12 @@ class CountPatternOp(RelationalOperator):
             if entry is None:
                 return None
         fn, args, valid = entry
+        # roofline numerator: the device arrays the fused program reads
+        # per execution (this op has no evaluated children to account)
+        import jax
+        self._fused_bytes = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(args)
+            if hasattr(x, "nbytes")) or getattr(fn, "nbytes_in", 0)
         self.strategy = "fused-spmv"
         return fn(*args), valid
 
@@ -1124,3 +1217,205 @@ class CountPatternOp(RelationalOperator):
             for h in self.hops)
         return (f"{self.out_name}=count(*), ({self.seed.var}){hops}, "
                 f"lengths={self.lengths}, strategy={self.strategy}")
+
+
+class CountCycleOp(CountPatternOp):
+    """Count directed-triangle matches — a 2-hop chain a->b->c plus a
+    closing edge between a and c — WITHOUT the join cascade.
+
+    The lowering enumerates the chain's 2-paths in fixed-shape device
+    batches and probes a sorted closing-edge key table:
+
+        W[j]   = out-degree (hop 2) of hop-1 edge j's endpoint b
+        P      = sum W — the number of 2-paths
+        path p = (edge j, k-th hop-2 neighbour of b), recovered with one
+                 searchsorted over cumsum(W)
+        count += multiplicity of key a*n + c in the closing edge set
+
+    ONE jitted program of batch size B serves every batch and every graph
+    scale — compile cost is O(1) in the graph, intermediates are bounded
+    by B, and parallel closing edges are counted exactly (the probe
+    returns multiplicity).  Relationship isomorphism is enforced
+    structurally: with no self-loop edges in any participating scan, the
+    three matched rel instances are necessarily pairwise distinct (any
+    coincidence forces a self-loop); graphs with self-loops fall back to
+    the join plan.  (Ref analog: Spark executes this query as a 5-way
+    shuffle-join cascade — reconstructed, mount empty; BASELINE.md
+    config 4.)
+    """
+
+    #: per-dispatch 2-path batch; one compile serves all batches
+    _BATCH = 1 << 20
+
+    def __init__(self, context, fallback, graph, out_name, seed: NodeSpec,
+                 hops: Sequence[HopSpec], close_hop: HopSpec):
+        super().__init__(context, fallback, graph, out_name, seed, hops,
+                         lengths=[2], uniq_pos=frozenset())
+        self.close_hop = close_hop
+
+    def _plan_sig(self):
+        ch = self.close_hop
+        return (super()._plan_sig(), "cycle",
+                tuple(sorted(set(ch.rel_types))), ch.direction)
+
+    def _compute_pushdown(self):
+        fused = self._fused_total()
+        if fused is None:
+            raise _Unsuitable("cycle count needs the fused device path")
+        self.strategy = "cycle-probe"
+        return self._emit_fused(*fused)
+
+    def _cycle_mask(self, st, spec: NodeSpec, n: int):
+        """Dense HOST bool mask over the id domain for one node var
+        (existence + labels + predicates), evaluated once at build time."""
+        scan = self._fused_scan(st, spec.labels)
+        if scan is None:
+            return None
+        _header, _t, _ok, host_ids, host_ok = scan
+        if spec.preds:
+            order = np.arange(host_ids.shape[0])
+            okp = self._fused_okpred(scan, spec, order)
+            if okp is None:
+                return None
+            ok = np.asarray(okp)
+        else:
+            ok = host_ok
+        dense = np.zeros((n,), bool)
+        ids = host_ids[ok]
+        dense[ids[(ids >= 0) & (ids < n)]] = True
+        return dense
+
+    def _build_fused(self, backend, gk):
+        import jax
+        import jax.numpy as jnp
+        st = self._graph_static(backend, gk)
+
+        h1, h2, ch = self.hops[0], self.hops[1], self.close_hop
+        relkeys = [tuple(sorted(set(h.rel_types))) for h in (h1, h2, ch)]
+        rels = [self._fused_rel(st, rk) for rk in relkeys]
+        if any(r is None for r in rels):
+            return None
+        # no self-loops anywhere rels participate: the structural
+        # guarantee that the three cycle rels are pairwise distinct
+        for src, tgt, ok in rels:
+            if src.shape[0] and bool(np.any((src == tgt) & ok)):
+                return None
+
+        seed_scan = self._fused_scan(st, self.seed.labels)
+        if seed_scan is None or \
+                self._fused_scan(st, h1.target.labels) is None or \
+                self._fused_scan(st, h2.target.labels) is None:
+            return None
+
+        mx = -1
+        for labels in (self.seed.labels, h1.target.labels, h2.target.labels):
+            _h, _t, _ok, host_ids, host_ok = st["scans"][("node", labels)]
+            if host_ids.shape[0] and host_ok.any():
+                mx = max(mx, int(host_ids[host_ok].max()))
+        for src, tgt, ok in rels:
+            if src.shape[0] and ok.any():
+                mx = max(mx, int(src[ok].max()), int(tgt[ok].max()))
+        n = mx + 1
+        if n <= 0:
+            n = 1
+        if n > _MAX_DOMAIN:
+            return None
+
+        m_a = self._cycle_mask(st, self.seed, n)
+        m_b = self._cycle_mask(st, h1.target, n)
+        m_c = self._cycle_mask(st, h2.target, n)
+        if m_a is None or m_b is None or m_c is None:
+            return None
+
+        def oriented(rel, direction):
+            src, tgt, ok = rel
+            return (src, tgt, ok) if direction == Direction.OUTGOING \
+                else (tgt, src, ok)
+
+        # hop 1 edges a->b, masked and compacted host-side (one-time)
+        f1, t1, ok1 = oriented(rels[0], h1.direction)
+        keep1 = ok1 & m_a[np.clip(f1, 0, n - 1)] & m_b[np.clip(t1, 0, n - 1)]
+        e1f = f1[keep1].astype(np.int32)
+        e1t = t1[keep1].astype(np.int32)
+
+        # hop 2 CSR b->c (c-mask applied so the probe needs no mask)
+        f2, t2, ok2 = oriented(rels[1], h2.direction)
+        keep2 = ok2 & m_b[np.clip(f2, 0, n - 1)] & m_c[np.clip(t2, 0, n - 1)]
+        f2c = f2[keep2].astype(np.int64)
+        t2c = t2[keep2].astype(np.int32)
+        order2 = np.argsort(f2c, kind="stable")
+        adj2 = t2c[order2]
+        starts2 = np.searchsorted(f2c[order2], np.arange(n + 1, dtype=np.int64),
+                                  side="left").astype(np.int64)
+        deg2 = (starts2[1:] - starts2[:-1]).astype(np.int64)
+
+        # closing edge key table a*n + c (multiplicity-preserving)
+        f3, t3, ok3 = oriented(rels[2], ch.direction)
+        keys = (f3[ok3].astype(np.int64) * n + t3[ok3].astype(np.int64))
+        keys = np.sort(keys)
+
+        W = deg2[np.clip(e1t, 0, n - 1)] if e1f.shape[0] else \
+            np.zeros((0,), np.int64)
+        cumW = np.cumsum(W, dtype=np.int64)
+        P = int(cumW[-1]) if cumW.shape[0] else 0
+
+        cap1 = backend.bucket(1)
+        valid = np.ones((cap1,), bool)
+        if P == 0 or keys.shape[0] == 0:
+            zero = jnp.zeros((cap1,), jnp.int64)
+            return ((lambda: zero), (), valid)
+
+        B = self._BATCH
+        d_cumW = backend.place_rows(jnp.asarray(cumW))
+        d_e1f = backend.place_rows(jnp.asarray(e1f))
+        d_e1t = backend.place_rows(jnp.asarray(e1t))
+        d_starts2 = backend.place_rows(jnp.asarray(starts2))
+        d_adj2 = backend.place_rows(jnp.asarray(adj2)) if adj2.shape[0] \
+            else jnp.zeros((1,), jnp.int32)
+        d_keys = backend.place_rows(jnp.asarray(keys))
+        n_i64 = jnp.int64(n)
+        P_i64 = jnp.int64(P)
+
+        @jax.jit
+        def batch(p0):
+            p = p0 + jnp.arange(B, dtype=jnp.int64)
+            live = p < P_i64
+            ps = jnp.where(live, p, 0)
+            j = jnp.searchsorted(d_cumW, ps, side="right")
+            j = jnp.minimum(j, d_cumW.shape[0] - 1)
+            prev = jnp.where(j > 0, d_cumW[jnp.maximum(j - 1, 0)], 0)
+            k = ps - prev
+            a = d_e1f[j].astype(jnp.int64)
+            b = d_e1t[j].astype(jnp.int64)
+            idx = jnp.minimum(d_starts2[b] + k, d_adj2.shape[0] - 1)
+            c = d_adj2[idx].astype(jnp.int64)
+            key = a * n_i64 + c
+            lo = jnp.searchsorted(d_keys, key, side="left")
+            hi = jnp.searchsorted(d_keys, key, side="right")
+            cnt = (hi - lo).astype(jnp.int64)
+            return jnp.where(live, cnt, 0).sum()
+
+        n_batches = (P + B - 1) // B
+
+        def run():
+            parts = [batch(jnp.int64(i * B)) for i in range(n_batches)]
+            total = parts[0]
+            for x in parts[1:]:
+                total = total + x
+            return jnp.zeros((cap1,), jnp.int64).at[0].set(total)
+
+        # roofline numerator: bytes each full execution reads (every batch
+        # probes the same resident arrays)
+        run.nbytes_in = n_batches * sum(
+            int(x.nbytes) for x in (d_cumW, d_e1f, d_e1t, d_starts2,
+                                    d_adj2, d_keys))
+        self.strategy = "cycle-probe"
+        return (run, (), valid)
+
+    def _pretty_args(self):
+        ch = self.close_hop
+        arrow = ">" if ch.direction == Direction.OUTGOING else "<"
+        return (f"{self.out_name}=count(*), triangle ({self.seed.var})"
+                f"->({self.hops[0].target.var})->({self.hops[1].target.var})"
+                f" closed by [:{'|'.join(ch.rel_types)}]{arrow}, "
+                f"strategy={self.strategy}")

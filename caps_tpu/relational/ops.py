@@ -29,6 +29,39 @@ from caps_tpu.relational.header import HeaderError, RecordHeader
 from caps_tpu.relational.table import AggSpec, Table
 
 
+ENTITY_CTX_PARAM = "__entity_ctx__"
+"""Reserved parameter key carrying the :class:`EntityContext` to the
+expression evaluators (popped before query-parameter lookup, excluded
+from fused-executor cache keys)."""
+
+
+class EntityContext:
+    """Host-side entity rehydration for expression evaluation: property /
+    label access on entity values flowing through list expressions, and
+    node-sequence reconstruction for var-length named paths.  One context
+    per planned graph — operators snapshot the context current at THEIR
+    planning time, so multi-graph queries (FROM GRAPH / UNION branches)
+    rehydrate against the graph they actually matched.  Lookups build
+    lazily so queries that never touch entity values pay nothing."""
+
+    def __init__(self, graph):
+        self._graph = graph
+        self._nodes: Optional[Dict] = None
+        self._rels: Optional[Dict] = None
+
+    def node(self, nid) -> Optional[Tuple[Tuple[str, ...], Dict[str, Any]]]:
+        if self._nodes is None:
+            g = self._graph
+            self._nodes = g.node_lookup() if g is not None else {}
+        return self._nodes.get(nid)
+
+    def rel(self, rid) -> Optional[Tuple[int, int, str, Dict[str, Any]]]:
+        if self._rels is None:
+            g = self._graph
+            self._rels = g.rel_lookup() if g is not None else {}
+        return self._rels.get(rid)
+
+
 class RelationalRuntimeContext:
     """Per-query context: parameters, session, catalog view (ref:
     ``RelationalRuntimeContext`` — SURVEY.md §2)."""
@@ -53,21 +86,45 @@ def resolve_expr(expr: E.Expr, header: RecordHeader) -> E.Expr:
         (the label cannot occur there);
       * ``HasType(r, T)`` → ``Type(r) = 'T'``;
       * ``Property`` on an entity var whose header lacks the column → null.
-    """
+
+    The walk is scope-aware: a comprehension / quantifier / reduce variable
+    that shadows a header entity var must NOT have its property reads
+    rewritten against the outer header."""
     entity_vars = set(header.entity_vars)
 
-    def rule(n: E.Expr) -> E.Expr:
+    def rw(n: E.Expr, bound: frozenset) -> E.Expr:
+        if isinstance(n, E.ListComprehension):
+            inner = bound | {n.var}
+            return dataclasses.replace(
+                n, list_expr=rw(n.list_expr, bound),
+                predicate=(rw(n.predicate, inner)
+                           if n.predicate is not None else None),
+                projection=(rw(n.projection, inner)
+                            if n.projection is not None else None))
+        if isinstance(n, E.QuantifiedPredicate):
+            return dataclasses.replace(
+                n, list_expr=rw(n.list_expr, bound),
+                predicate=rw(n.predicate, bound | {n.var}))
+        if isinstance(n, E.Reduce):
+            return dataclasses.replace(
+                n, init=rw(n.init, bound),
+                list_expr=rw(n.list_expr, bound),
+                expr=rw(n.expr, bound | {n.acc, n.var}))
+        n = n.map_children(lambda c: rw(c, bound))
         if isinstance(n, E.HasLabel) and isinstance(n.node, E.Var) \
+                and n.node.name not in bound \
                 and n.node.name in entity_vars and not header.has(n):
             return E.Lit(False)
-        if isinstance(n, E.HasType) and isinstance(n.rel, E.Var):
+        if isinstance(n, E.HasType) and isinstance(n.rel, E.Var) \
+                and n.rel.name not in bound:
             return E.Equals(E.Type(n.rel), E.Lit(n.rel_type))
         if isinstance(n, E.Property) and isinstance(n.entity, E.Var) \
+                and n.entity.name not in bound \
                 and n.entity.name in entity_vars and not header.has(n):
             return E.Lit(None)
         return n
 
-    return expr.transform_up(rule)
+    return rw(expr, frozenset())
 
 
 def host_eval(expr: E.Expr, parameters: Mapping[str, Any]) -> Any:
@@ -91,6 +148,20 @@ class RelationalOperator(abc.ABC):
         self.context = context
         self.children = tuple(children)
         self._result: Optional[Tuple[RecordHeader, Table]] = None
+        # snapshot of the planner's graph-scoped entity context at THIS
+        # op's planning time (multi-graph correctness — see EntityContext)
+        self.entity_ctx: Optional[EntityContext] = getattr(
+            context, "entity_ctx", None)
+
+    @property
+    def parameters(self) -> Dict[str, Any]:
+        """Query parameters plus this op's entity-context snapshot under
+        the reserved key (backends pop it before parameter lookup)."""
+        if self.entity_ctx is None:
+            return self.context.parameters
+        p = dict(self.context.parameters)
+        p[ENTITY_CTX_PARAM] = self.entity_ctx
+        return p
 
     @abc.abstractmethod
     def _compute(self) -> Tuple[RecordHeader, Table]:
@@ -105,11 +176,19 @@ class RelationalOperator(abc.ABC):
                     if _TraceAnnotation is not None else nullcontext())
             with span:
                 self._result = self._compute()
-            try:  # bytes pulled through memory by this operator (children
-                # are already evaluated, so .table reads the cache): the
-                # roofline numerator (SURVEY.md §5.5)
-                bytes_in = (sum(c.table.nbytes for c in self.children)
-                            if self.children else self._result[1].nbytes)
+            try:  # bytes pulled through memory by this operator: the
+                # roofline numerator (SURVEY.md §5.5).  Only children the
+                # op actually evaluated count — summing `c.table` blindly
+                # would FORCE lazy children (e.g. the count-pushdown's
+                # fallback join plan) just for accounting.
+                evaluated = [c for c in self.children
+                             if c._result is not None]
+                if evaluated:
+                    bytes_in = sum(c.table.nbytes for c in evaluated)
+                elif self.children:
+                    bytes_in = 0  # pushdown path: children never ran
+                else:
+                    bytes_in = self._result[1].nbytes
             except Exception:  # pragma: no cover — accounting must not fail
                 bytes_in = 0
             self.context.op_metrics.append({
@@ -186,7 +265,7 @@ class FilterOp(RelationalOperator):
     def _compute(self):
         header, table = self.children[0].result
         pred = resolve_expr(self.predicate, header)
-        return header, table.filter(pred, header, self.context.parameters)
+        return header, table.filter(pred, header, self.parameters)
 
     def _pretty_args(self):
         return self.predicate.cypher_repr()
@@ -221,7 +300,7 @@ class ProjectOp(RelationalOperator):
 
     def _compute(self):
         header, table = self.children[0].result
-        params = self.context.parameters
+        params = self.parameters
         overwritten = [name for name, expr, _ in self.items
                        if name in set(header.vars) and expr != E.Var(name)]
         pending_renames: Dict[str, str] = {}
@@ -424,7 +503,7 @@ class AggregateOp(RelationalOperator):
 
     def _compute(self):
         header, table = self.children[0].result
-        params = self.context.parameters
+        params = self.parameters
 
         by_cols: List[str] = []
         out_entries: List[Tuple[E.Expr, str, CypherType]] = []
@@ -511,7 +590,7 @@ class OrderByOp(RelationalOperator):
 
     def _compute(self):
         header, table = self.children[0].result
-        params = self.context.parameters
+        params = self.parameters
         sort_cols: List[Tuple[str, bool]] = []
         temp_cols: List[str] = []
         for i, (expr, asc) in enumerate(self.items):
@@ -560,7 +639,7 @@ class UnwindOp(RelationalOperator):
 
     def _compute(self):
         header, table = self.children[0].result
-        params = self.context.parameters
+        params = self.parameters
         resolved = resolve_expr(self.list_expr, header)
         tmp = f"__unwind__{self.var}"
         from caps_tpu.okapi.types import CTAny, CTList

@@ -34,6 +34,7 @@ class RelationalPlanner:
         self.context = context
         self.ambient_graph = ambient_graph
         self.graph_resolver = graph_resolver
+        self._entity_ctx_cache: Dict[int, R.EntityContext] = {}
         self.current_graph = ambient_graph
         self._memo: Dict[L.LogicalOperator, R.RelationalOperator] = {}
         self._fresh = 0
@@ -50,6 +51,22 @@ class RelationalPlanner:
         # single-hop rel var -> its pattern endpoints (for the
         # startNode()/endNode() property rewrite in _fix)
         self._rel_endpoints: Dict[str, Tuple[str, str]] = {}
+
+    @property
+    def current_graph(self) -> RelationalCypherGraph:
+        return self._current_graph
+
+    @current_graph.setter
+    def current_graph(self, g: RelationalCypherGraph) -> None:
+        # keep one EntityContext per graph so ops planned while this graph
+        # is current share lookup caches (and multi-graph queries rehydrate
+        # against the right graph — RelationalOperator snapshots this)
+        self._current_graph = g
+        ctx = self._entity_ctx_cache.get(id(g))
+        if ctx is None:
+            ctx = R.EntityContext(g)
+            self._entity_ctx_cache[id(g)] = ctx
+        self.context.entity_ctx = ctx
 
     def fresh(self, prefix: str) -> str:
         self._fresh += 1

@@ -1,0 +1,376 @@
+Feature: List comprehensions, quantified predicates and reduce
+
+  Scenario: property access on entities inside a list comprehension
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:Person {name: 'Alice'})-[:KNOWS]->(:Person {name: 'Bob'})
+      """
+    When executing query:
+      """
+      MATCH (a)-[:KNOWS]->(b) RETURN [n IN [a, b] | n.name] AS names
+      """
+    Then the result should be, in any order:
+      | names            |
+      | ['Alice', 'Bob'] |
+
+  Scenario: label predicate on entities inside a list comprehension
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})-[:T]->(:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a)-[:T]->(b) RETURN [n IN [a, b] WHERE n:B | n.v] AS vs
+      """
+    Then the result should be, in any order:
+      | vs  |
+      | [2] |
+
+  Scenario: labels and keys of comprehension-bound entities
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A:B {x: 1, y: 2})
+      """
+    When executing query:
+      """
+      MATCH (n:A) RETURN [m IN [n] | labels(m)] AS ls, [m IN [n] | keys(m)] AS ks
+      """
+    Then the result should be, in any order:
+      | ls         | ks           |
+      | [['A', 'B']] | [['x', 'y']] |
+
+  Scenario: relationship accessors inside a list comprehension
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})-[:T {w: 9}]->(:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a)-[r:T]->(b)
+      RETURN [x IN [r] | type(x)] AS ts, [x IN [r] | x.w] AS ws
+      """
+    Then the result should be, in any order:
+      | ts    | ws  |
+      | ['T'] | [9] |
+
+  Scenario: comprehension over collected entities after WITH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'Alice', age: 30}), (:P {name: 'Bob', age: 17})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WITH collect(p) AS ps
+      RETURN [x IN ps WHERE x.age >= 18 | x.name] AS adults
+      """
+    Then the result should be, in any order:
+      | adults    |
+      | ['Alice'] |
+
+  Scenario: comprehension variable shadows an outer entity variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})-[:T]->(:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a)-[:T]->(b) RETURN [a IN [b] | a.v] AS vs
+      """
+    Then the result should be, in any order:
+      | vs  |
+      | [2] |
+
+  Scenario: nested comprehensions see the enclosing lambda variable
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN [x IN [1, 2] | [y IN [10, 20] | x * y]] AS m
+      """
+    Then the result should be, in any order:
+      | m                      |
+      | [[10, 20], [20, 40]]   |
+
+  Scenario: comprehension over a null list is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN [x IN p.missing | x + 1] AS l
+      """
+    Then the result should be, in any order:
+      | l    |
+      | null |
+
+  Scenario: all with true, false and null verdicts
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN all(x IN [1, 2] WHERE x > 0) AS t,
+             all(x IN [1, -1] WHERE x > 0) AS f,
+             all(x IN [1, null] WHERE x > 0) AS u,
+             all(x IN [] WHERE x > 0) AS e
+      """
+    Then the result should be, in any order:
+      | t    | f     | u    | e    |
+      | true | false | null | true |
+
+  Scenario: any with true, false and null verdicts
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN any(x IN [-1, 2] WHERE x > 0) AS t,
+             any(x IN [-1, -2] WHERE x > 0) AS f,
+             any(x IN [null, -1] WHERE x > 0) AS u,
+             any(x IN [null, 2] WHERE x > 0) AS tn,
+             any(x IN [] WHERE x > 0) AS e
+      """
+    Then the result should be, in any order:
+      | t    | f     | u    | tn   | e     |
+      | true | false | null | true | false |
+
+  Scenario: none is the negation of any
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN none(x IN [-1, -2] WHERE x > 0) AS t,
+             none(x IN [-1, 2] WHERE x > 0) AS f,
+             none(x IN [null] WHERE x > 0) AS u
+      """
+    Then the result should be, in any order:
+      | t    | f     | u    |
+      | true | false | null |
+
+  Scenario: single demands exactly one match
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN single(x IN [1, -1] WHERE x > 0) AS t,
+             single(x IN [1, 2] WHERE x > 0) AS f,
+             single(x IN [-1, -2] WHERE x > 0) AS z,
+             single(x IN [1, null] WHERE x > 0) AS u,
+             single(x IN [1, 2, null] WHERE x > 0) AS fn
+      """
+    Then the result should be, in any order:
+      | t    | f     | z     | u    | fn    |
+      | true | false | false | null | false |
+
+  Scenario: quantifier over entity list in WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'Alice', age: 30})-[:K]->(:P {name: 'Bob', age: 17}),
+             (:P {name: 'Carol', age: 40})-[:K]->(:P {name: 'Dan', age: 45})
+      """
+    When executing query:
+      """
+      MATCH (a)-[:K]->(b)
+      WHERE all(n IN [a, b] WHERE n.age >= 18)
+      RETURN a.name AS nm
+      """
+    Then the result should be, in any order:
+      | nm      |
+      | 'Carol' |
+
+  Scenario: reduce over integers and strings
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN reduce(t = 0, x IN [1, 2, 3] | t + x) AS s,
+             reduce(s = '!', x IN ['a', 'b'] | s + x) AS c,
+             reduce(t = 0, x IN [] | t + x) AS e
+      """
+    Then the result should be, in any order:
+      | s | c     | e |
+      | 6 | '!ab' | 0 |
+
+  Scenario: reduce over entity properties
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 10})-[:T]->(:P {v: 32})
+      """
+    When executing query:
+      """
+      MATCH (a)-[:T]->(b)
+      RETURN reduce(t = 0, n IN [a, b] | t + n.v) AS s
+      """
+    Then the result should be, in any order:
+      | s  |
+      | 42 |
+
+  Scenario: reduce over a null list is null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN reduce(t = 0, x IN p.missing | t + x) AS s
+      """
+    Then the result should be, in any order:
+      | s    |
+      | null |
+
+  Scenario: filter and extract legacy forms
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN filter(x IN [1, -2, 3] WHERE x > 0) AS f,
+             extract(x IN [1, 2] | x * 10) AS e
+      """
+    Then the result should be, in any order:
+      | f      | e        |
+      | [1, 3] | [10, 20] |
+
+  Scenario: comprehension projecting entities returns entity values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})-[:T]->(:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a:A)-[:T]->(b) RETURN [n IN [a, b] WHERE n.v > 1 | n] AS ns
+      """
+    Then the result should be, in any order:
+      | ns             |
+      | [(:B {v: 2})]  |
+
+  Scenario: nodes on a var-length path inside a comprehension
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'Alice'})-[:K]->(:P {name: 'Bob'})-[:K]->(:P {name: 'Carol'})
+      """
+    When executing query:
+      """
+      MATCH p = (:P {name: 'Alice'})-[:K*1..2]->(x)
+      RETURN [n IN nodes(p) | n.name] AS names
+      """
+    Then the result should be, in any order:
+      | names                     |
+      | ['Alice', 'Bob']          |
+      | ['Alice', 'Bob', 'Carol'] |
+
+  Scenario: unwinding nodes of a var-length path rehydrates entities
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'Alice'})-[:K]->(:P {name: 'Bob'})-[:K]->(:P {name: 'Carol'})
+      """
+    When executing query:
+      """
+      MATCH p = (:P {name: 'Alice'})-[:K*2]->(x)
+      UNWIND nodes(p) AS n RETURN n.name AS nm
+      """
+    Then the result should be, in any order:
+      | nm      |
+      | 'Alice' |
+      | 'Bob'   |
+      | 'Carol' |
+
+  Scenario: size of nodes on a var-length path after projection
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1})-[:K]->(:P {v: 2})-[:K]->(:P {v: 3})
+      """
+    When executing query:
+      """
+      MATCH p = (:P {v: 1})-[:K*2]->(x) WITH p AS q
+      RETURN size(nodes(q)) AS n, [m IN nodes(q) | m.v] AS vs
+      """
+    Then the result should be, in any order:
+      | n | vs        |
+      | 3 | [1, 2, 3] |
+
+  Scenario: relationship properties over a var-length path comprehension
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)-[:K {w: 1}]->(:P)-[:K {w: 2}]->(:P)
+      """
+    When executing query:
+      """
+      MATCH p = (:P)-[:K*2]->(x)
+      RETURN [r IN relationships(p) | r.w] AS ws
+      """
+    Then the result should be, in any order:
+      | ws     |
+      | [1, 2] |
+
+  Scenario: quantifier over relationships of a var-length path
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {name: 'a'})-[:K {w: 1}]->(:P)-[:K {w: 5}]->(:P {name: 'c'})
+      """
+    When executing query:
+      """
+      MATCH p = (:P {name: 'a'})-[:K*2]->(x)
+      RETURN all(r IN relationships(p) WHERE r.w > 0) AS pos,
+             any(r IN relationships(p) WHERE r.w > 3) AS big
+      """
+    Then the result should be, in any order:
+      | pos  | big  |
+      | true | true |
+
+  Scenario: startNode and endNode inside a comprehension
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {v: 1})-[:T]->(:B {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (a)-[r:T]->(b)
+      RETURN [x IN [r] | id(startNode(x)) = id(a)] AS s,
+             [x IN [r] | id(endNode(x)) = id(b)] AS e
+      """
+    Then the result should be, in any order:
+      | s      | e      |
+      | [true] | [true] |
+
+  Scenario: comprehension over map values yields property lookups
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN [m IN [{a: 1}, {a: 2}] | m.a] AS vs
+      """
+    Then the result should be, in any order:
+      | vs     |
+      | [1, 2] |
+
+  Scenario: quantifiers treat a null list as null
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      RETURN all(x IN p.missing WHERE x > 0) AS a,
+             any(x IN p.missing WHERE x > 0) AS y
+      """
+    Then the result should be, in any order:
+      | a    | y    |
+      | null | null |

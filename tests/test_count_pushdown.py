@@ -22,6 +22,10 @@ def _random_graph(session, n=120, e=500, seed=7, self_loops=True):
              for _ in range(e)]
     if self_loops:
         edges += [(5, 5, {}), (5, 5, {}), (9, 9, {})]
+    else:
+        # genuinely loop-free (the cycle-probe plan requires it; chance
+        # loops from the RNG would force its structural fallback)
+        edges = [(a, b, p) for a, b, p in edges if a != b]
     return make_graph(session, nodes, {"K": edges})
 
 
@@ -288,3 +292,79 @@ def test_untyped_and_typed_hops_edge_reuse_correction():
         assert "CountPattern" in _ops(res), q
         want = oracle.cypher(q).records.to_maps()
         assert res.records.to_maps() == want, (q, want)
+
+
+def test_star_pattern_not_miscounted_as_chain():
+    """Round-5 regression: (a)->(b), (a)->(c) type-checks as 2 hops over 3
+    node vars but is NOT a chain; the walk must verify source continuity
+    (counting it as a->b->c silently returned 0 matches)."""
+    q = "MATCH (a:P)-[r:K]->(b), (a)-[s:K]->(c) RETURN count(*) AS c"
+    oracle = _random_graph(LocalCypherSession())
+    session = TPUCypherSession()
+    g = _random_graph(session)
+    res = g.cypher(q)
+    want = oracle.cypher(q).records.to_maps()
+    assert res.records.to_maps() == want
+    assert want[0]["c"] > 0
+
+
+def test_pushdown_does_not_execute_fallback_join_plan():
+    """Round-5 regression: the roofline bytes accounting forced the lazy
+    fallback child, executing the whole join cascade alongside every
+    successful pushdown."""
+    session = TPUCypherSession()
+    g = _random_graph(session)
+    res = g.cypher("MATCH (a:P)-[:K]->(b)-[:K]->(c) RETURN count(*) AS c")
+    ops = _ops(res)
+    assert "CountPattern" in ops
+    assert "Join" not in ops, ops
+
+
+TRIANGLE_QUERIES = [
+    # canonical oriented triangle (benchmark config 4 shape)
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c), (a)-[:K]->(c) RETURN count(*) AS c",
+    # closing edge written in the reverse orientation
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c), (c)-[:K]->(a) RETURN count(*) AS c",
+    # closing edge written as an incoming pattern on a
+    "MATCH (a:P)-[:K]->(b)-[:K]->(c), (a)<-[:K]-(c) RETURN count(*) AS c",
+    # seed predicate + mixed chain directions
+    "MATCH (a:P)-[:K]->(b)<-[:K]-(c), (a)-[:K]->(c) "
+    "WHERE a.name = 'n5' RETURN count(*) AS c",
+]
+
+
+@pytest.mark.parametrize("query", TRIANGLE_QUERIES)
+@pytest.mark.parametrize("self_loops", [False, True],
+                         ids=["clean", "self-loops"])
+def test_cycle_count_matches_oracle(query, self_loops):
+    """The triangle cycle-probe plan must agree with the oracle; graphs
+    WITH self-loops must fall back (rel-instance coincidences become
+    possible) and still agree."""
+    oracle = _random_graph(LocalCypherSession(), self_loops=self_loops)
+    session = TPUCypherSession()
+    g = _random_graph(session, self_loops=self_loops)
+    res = g.cypher(query)
+    want = oracle.cypher(query).records.to_maps()
+    assert res.records.to_maps() == want, query
+    assert "CountCycle" in _ops(res), res.plans["relational"]
+    strat = [m for m in res.metrics["operators"]
+             if m["op"] == "CountCycle"][0]["strategy"]
+    if self_loops:
+        assert strat == "fallback-join"
+    else:
+        assert strat == "cycle-probe"
+        assert "Join" not in _ops(res)
+
+
+def test_cycle_count_parallel_closing_edges():
+    """Parallel closing edges each produce a distinct match (the probe
+    returns key multiplicity)."""
+    nodes = {("P",): [{"_id": i} for i in range(3)]}
+    edges = [(0, 1, {}), (1, 2, {}), (0, 2, {}), (0, 2, {})]
+    oracle = make_graph(LocalCypherSession(), nodes, {"K": edges})
+    session = TPUCypherSession()
+    g = make_graph(session, nodes, {"K": edges})
+    q = "MATCH (a:P)-[:K]->(b)-[:K]->(c), (a)-[:K]->(c) RETURN count(*) AS c"
+    res = g.cypher(q)
+    want = oracle.cypher(q).records.to_maps()
+    assert res.records.to_maps() == want == [{"c": 2}]

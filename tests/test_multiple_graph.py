@@ -205,3 +205,21 @@ def test_fs_no_combo_collision(session, tmp_path):
                          ).records.to_maps() == [{"v": 1}]
     assert loaded.cypher("MATCH (n:A:B) RETURN n.v AS v"
                          ).records.to_maps() == [{"v": 2}]
+
+
+@pytest.mark.parametrize("session_cls", [LocalCypherSession, TPUCypherSession])
+def test_union_branches_rehydrate_from_their_own_graph(session_cls):
+    """Round-5 review finding: entity access inside list expressions must
+    resolve against the graph each UNION branch matched, not the planner's
+    final current graph."""
+    from caps_tpu.okapi.graph import QualifiedGraphName
+    s = session_cls()
+    g1 = create_graph(s, "CREATE (:A {v: 'g1'})")
+    g2 = create_graph(s, "CREATE (:A {v: 'g2'})")
+    s.catalog.store(QualifiedGraphName.parse("session.g1"), g1)
+    s.catalog.store(QualifiedGraphName.parse("session.g2"), g2)
+    r = s.cypher(
+        "FROM GRAPH session.g1 MATCH (n:A) RETURN [x IN [n] | x.v] AS v "
+        "UNION ALL "
+        "FROM GRAPH session.g2 MATCH (m:A) RETURN [x IN [m] | x.v] AS v")
+    assert Bag(r.to_maps()) == Bag([{"v": ["g1"]}, {"v": ["g2"]}])
