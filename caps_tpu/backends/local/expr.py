@@ -13,7 +13,10 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 from caps_tpu.ir import exprs as E
 from caps_tpu.okapi.types import _CTList, _CTNode, _CTRelationship
-from caps_tpu.okapi.values import cypher_equals, cypher_lt
+from caps_tpu.okapi.values import (
+    CypherDate, CypherDateTime, CypherDuration, cypher_equals, cypher_lt,
+    is_temporal, temporal_component, temporal_construct,
+)
 from caps_tpu.relational.header import RecordHeader
 
 GetCol = Callable[[str], List[Any]]
@@ -114,9 +117,12 @@ class _Evaluator:
             raise ExprEvalError(f"keys()/properties() on {ent!r}")
         if isinstance(e, E.Property):
             # property of a map value (header-resident entity props were
-            # handled by the header lookup above)
+            # handled by the header lookup above) or a temporal component
             base = self.eval(e.entity)
-            return [None if m is None else (m.get(e.key) if isinstance(m, dict) else None)
+            return [None if m is None
+                    else (m.get(e.key) if isinstance(m, dict)
+                          else temporal_component(m, e.key) if is_temporal(m)
+                          else None)
                     for m in base]
         if isinstance(e, E.HasLabel):
             raise ExprEvalError(f"{e!r} not in header (unknown label column)")
@@ -446,6 +452,9 @@ class _Evaluator:
             if a is None or b is None:
                 out.append(None)
                 continue
+            if is_temporal(a) or is_temporal(b):
+                out.append(self._temporal_arith(e, a, b))
+                continue
             try:
                 if isinstance(e, E.Add):
                     if isinstance(a, str) or isinstance(b, str):
@@ -477,6 +486,28 @@ class _Evaluator:
             except ZeroDivisionError:
                 raise ExprEvalError("division by zero")
         return out
+
+    @staticmethod
+    def _temporal_arith(e, a, b):
+        """date/datetime ± duration, duration ± duration (openCypher's
+        defined temporal arithmetic; anything else is a type error →
+        lenient null, matching the engine's out-of-domain convention)."""
+        if isinstance(e, E.Add):
+            if isinstance(a, (CypherDate, CypherDateTime)) \
+                    and isinstance(b, CypherDuration):
+                return a.plus(b)
+            if isinstance(a, CypherDuration) \
+                    and isinstance(b, (CypherDate, CypherDateTime)):
+                return b.plus(a)
+            if isinstance(a, CypherDuration) and isinstance(b, CypherDuration):
+                return a.plus(b)
+        elif isinstance(e, E.Subtract):
+            if isinstance(a, (CypherDate, CypherDateTime)) \
+                    and isinstance(b, CypherDuration):
+                return a.plus(b.negate())
+            if isinstance(a, CypherDuration) and isinstance(b, CypherDuration):
+                return a.plus(b.negate())
+        return None
 
     def _function(self, e: E.FunctionExpr) -> List[Any]:
         args = [self.eval(a) for a in e.args]
@@ -538,6 +569,9 @@ class _BoundEvaluator(_Evaluator):
     def _entity_field(self, e: E.Expr, v: Any, kind: Optional[str]) -> Any:
         if v is None:
             return None
+        if is_temporal(v):
+            return temporal_component(v, e.key) \
+                if isinstance(e, E.Property) else None
         if isinstance(v, dict):  # map values bound to the variable
             if isinstance(e, E.Property):
                 return v.get(e.key)
@@ -634,6 +668,8 @@ def _to_str(v) -> str:
         return "true" if v else "false"
     if v is None:
         return "null"
+    if is_temporal(v):
+        return v.iso()
     return str(v)
 
 
@@ -645,7 +681,29 @@ def _null_guard(fn):
     return wrapped
 
 
+_MISSING = object()
+
+
+def _temporal_fn(name):
+    def make(v=_MISSING):
+        if v is _MISSING:
+            raise ExprEvalError(
+                f"{name}() without an argument (current time) is "
+                "non-deterministic and not supported")
+        if v is None:
+            return None  # null argument propagates
+        try:
+            return temporal_construct(name, v)
+        except ValueError as ex:
+            raise ExprEvalError(str(ex))
+    return make
+
+
 _FUNCTIONS: Dict[str, Callable] = {
+    "date": _temporal_fn("date"),
+    "datetime": _temporal_fn("datetime"),
+    "localdatetime": _temporal_fn("localdatetime"),
+    "duration": _temporal_fn("duration"),
     "tostring": lambda v: None if v is None else _to_str(v),
     "tointeger": lambda v: _to_int(v),
     "toint": lambda v: _to_int(v),

@@ -24,8 +24,8 @@ import numpy as np
 
 from caps_tpu import native
 from caps_tpu.okapi.types import (
-    CTBoolean, CTFloat, CTInteger, CTNumber, CTString, CypherType, _CTList,
-    _CTNode, _CTRelationship,
+    CTBoolean, CTDate, CTDateTime, CTFloat, CTInteger, CTNumber, CTString,
+    CypherType, _CTList, _CTNode, _CTRelationship,
 )
 
 jax.config.update("jax_enable_x64", True)
@@ -37,6 +37,10 @@ _DTYPES = {
     "bool": jnp.bool_,
     "str": jnp.int32,
     "list": jnp.int32,
+    # temporal: one int64 each (epoch days / epoch microseconds);
+    # durations are 3-component and stay host-only ("object")
+    "date": jnp.int64,
+    "datetime": jnp.int64,
 }
 
 
@@ -76,6 +80,10 @@ def kind_for(ctype: CypherType) -> str:
         return "bool"
     if m == CTString:
         return "str"
+    if m == CTDate:
+        return "date"
+    if m == CTDateTime:
+        return "datetime"
     return "object"
 
 
@@ -143,7 +151,8 @@ def make_column(values: List[Any], ctype: CypherType, capacity: int,
         valid_np[:n] = codes >= 0
         return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np),
                       ctype, host=(data_np, valid_np))
-    fast = _make_column_native(values, kind, n)
+    fast = None if kind in ("date", "datetime") \
+        else _make_column_native(values, kind, n)
     if fast is not None:
         d, v = fast
         data_np[:n] = d
@@ -160,6 +169,12 @@ def make_column(values: List[Any], ctype: CypherType, capacity: int,
             data_np[i] = _check_id(int(v))
         elif kind == "float":
             data_np[i] = float(v)
+        elif kind == "date":
+            from caps_tpu.okapi.values import CypherDate
+            data_np[i] = v.days if isinstance(v, CypherDate) else int(v)
+        elif kind == "datetime":
+            from caps_tpu.okapi.values import CypherDateTime
+            data_np[i] = v.micros if isinstance(v, CypherDateTime) else int(v)
         else:
             data_np[i] = int(v)
     return Column(kind, jnp.asarray(data_np), jnp.asarray(valid_np), ctype,
@@ -254,6 +269,12 @@ def column_to_host(col: Column, n: int, pool) -> List[Any]:
             out.append(bool(data[i]))
         elif col.kind == "float":
             out.append(float(data[i]))
+        elif col.kind == "date":
+            from caps_tpu.okapi.values import CypherDate
+            out.append(CypherDate(int(data[i])))
+        elif col.kind == "datetime":
+            from caps_tpu.okapi.values import CypherDateTime
+            out.append(CypherDateTime(int(data[i])))
         else:
             out.append(int(data[i]))
     return out

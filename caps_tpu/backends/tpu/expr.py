@@ -416,6 +416,25 @@ class DeviceExprCompiler:
 
     def _function(self, e: E.FunctionExpr) -> Column:  # noqa: C901
         name = e.name
+        if name in ("date", "datetime", "localdatetime") \
+                and len(e.args) == 1 and isinstance(e.args[0], E.Lit) \
+                and isinstance(e.args[0].value, str):
+            # constant temporal literal → one int64 constant column (the
+            # encodings are device-comparable; see column.py kinds)
+            from caps_tpu.okapi.types import CTDate, CTDateTime
+            from caps_tpu.okapi.values import CypherDate, CypherDateTime
+            try:
+                if name == "date":
+                    enc, kind, ct = (CypherDate.parse(e.args[0].value).days,
+                                     "date", CTDate)
+                else:
+                    enc, kind, ct = (
+                        CypherDateTime.parse(e.args[0].value).micros,
+                        "datetime", CTDateTime)
+            except ValueError as ex:
+                raise UnsupportedOnDevice(str(ex))
+            return Column(kind, jnp.full((self.capacity,), enc, jnp.int64),
+                          jnp.ones((self.capacity,), bool), ct)
         args = [self.compile(a) for a in e.args]
 
         unary_float = {"sqrt": jnp.sqrt, "exp": jnp.exp, "log": jnp.log,

@@ -40,7 +40,8 @@ class TPUCypherSession(RelationalCypherSession):
         result's metrics as per-query deltas."""
         be = self.backend
         before = (be.ici_bytes, be.dist_joins, be.broadcast_joins,
-                  be.fallbacks, be.syncs)
+                  be.fallbacks, be.syncs, be.ici_payload_bytes,
+                  be.salted_joins)
         if not self.config.use_fused:
             result = super()._cypher_on_graph(graph, query, parameters)
         else:
@@ -54,6 +55,9 @@ class TPUCypherSession(RelationalCypherSession):
             result.metrics["broadcast_joins"] = be.broadcast_joins - before[2]
             result.metrics["device_fallbacks"] = be.fallbacks - before[3]
             result.metrics["size_syncs"] = be.syncs - before[4]
+            result.metrics["ici_payload_bytes"] = \
+                be.ici_payload_bytes - before[5]
+            result.metrics["salted_joins"] = be.salted_joins - before[6]
         return result
 
     @property

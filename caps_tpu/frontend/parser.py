@@ -687,7 +687,7 @@ class CypherParser:
             if len(args) != 2:
                 raise self.error(f"{lname}() takes two arguments")
             cls = E.PercentileCont if lname == "percentilecont" else E.PercentileDisc
-            return cls(args[0], args[1])
+            return cls(args[0], args[1], distinct)
         raise self.error(f"unknown aggregator {lname}")
 
     def _parse_atom(self) -> E.Expr:

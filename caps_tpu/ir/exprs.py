@@ -535,12 +535,14 @@ class StDev(Aggregator):
 class PercentileCont(Aggregator):
     expr: Expr
     percentile: Expr
+    distinct: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
 class PercentileDisc(Aggregator):
     expr: Expr
     percentile: Expr
+    distinct: bool = False
 
 
 AGGREGATOR_NAMES = {

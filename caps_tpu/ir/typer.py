@@ -54,12 +54,15 @@ class SchemaTyper:
             return CTMap
 
         if isinstance(e, E.Property):
+            from caps_tpu.okapi.types import CTDate, CTDateTime, CTDuration
             et = rec(e.entity)
             m = et.material
             if isinstance(m, _CTNode):
                 t = self.schema.node_property_type(m.labels, e.key)
             elif isinstance(m, _CTRelationship):
                 t = self.schema.relationship_property_type(m.rel_types, e.key)
+            elif m in (CTDate, CTDateTime, CTDuration):
+                t = CTInteger.nullable  # temporal component accessor
             else:
                 t = CTAny  # maps / CTAny entities: untyped property access
             return t.nullable if et.is_nullable and t != CTNull else t
@@ -123,10 +126,23 @@ class SchemaTyper:
 
         if isinstance(e, (E.Add, E.Subtract, E.Multiply, E.Divide, E.Modulo,
                           E.Power)):
+            from caps_tpu.okapi.types import CTDate, CTDateTime, CTDuration
             lt, rt = rec(e.lhs), rec(e.rhs)
             if lt == CTNull or rt == CTNull:
                 return CTNull
             lm, rm = lt.material, rt.material
+            temporal = {CTDate, CTDateTime, CTDuration}
+            if lm in temporal or rm in temporal:
+                nullable = lt.is_nullable or rt.is_nullable
+                if {lm, rm} == {CTDuration}:
+                    out: CypherType = CTDuration
+                elif CTDate in (lm, rm):
+                    out = CTDate
+                elif CTDateTime in (lm, rm):
+                    out = CTDateTime
+                else:
+                    out = CTAny
+                return out.nullable if nullable else out
             # String/list concatenation via +
             if isinstance(e, E.Add) and (lm == CTString or rm == CTString):
                 out: CypherType = CTString
@@ -276,4 +292,13 @@ class SchemaTyper:
             return CTFloat
         if name == "timestamp":
             return CTInteger
+        if name == "date":
+            from caps_tpu.okapi.types import CTDate
+            return wrap(CTDate)
+        if name in ("datetime", "localdatetime"):
+            from caps_tpu.okapi.types import CTDateTime
+            return wrap(CTDateTime)
+        if name == "duration":
+            from caps_tpu.okapi.types import CTDuration
+            return wrap(CTDuration)
         return CTAny

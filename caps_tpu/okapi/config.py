@@ -25,6 +25,11 @@ def _env_int(name: str, default: int) -> int:
     return int(v) if v is not None else default
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v is not None else default
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     # Debug printing (the reference's PrintIr / PrintLogicalPlan / ... flags)
@@ -81,11 +86,19 @@ class EngineConfig:
     # exchanging (Spark's autoBroadcastJoinThreshold analog, in rows).
     broadcast_join_threshold: int = dataclasses.field(
         default_factory=lambda: _env_int("CAPS_TPU_BROADCAST_ROWS", 4096))
-    # Skew salting factor for the radix exchange: probe rows of one key
-    # spread over `join_salt` sub-buckets, build rows replicate into all
-    # of them (power-law key guards; 1 = off).
+    # Skew salting for the radix exchange (surgical: ONLY detected-hot
+    # keys replicate).  join_salt > 1 forces that salt factor; 1 = pick
+    # automatically from the probe-key sample (salt stays 1 when no key
+    # exceeds join_hot_factor x the per-device fair share).
     join_salt: int = dataclasses.field(
         default_factory=lambda: _env_int("CAPS_TPU_JOIN_SALT", 1))
+    # A sampled key is "hot" when its frequency exceeds this multiple of
+    # the per-device fair share (SURVEY.md §5.8 skew handling).
+    join_hot_factor: float = dataclasses.field(
+        default_factory=lambda: _env_float("CAPS_TPU_JOIN_HOT_FACTOR", 4.0))
+    # At most this many hot keys ride the device-resident hot set.
+    join_hot_capacity: int = dataclasses.field(
+        default_factory=lambda: _env_int("CAPS_TPU_JOIN_HOT_CAP", 16))
     # Fused executor (backends/tpu/fused.py): record data-dependent sizes
     # on a query's first run, replay them sync-free on repeats.
     use_fused: bool = dataclasses.field(

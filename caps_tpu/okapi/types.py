@@ -194,6 +194,21 @@ class _CTPath(CypherType):
 
 
 @dataclasses.dataclass(frozen=True, repr=False)
+class _CTDate(CypherType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class _CTDateTime(CypherType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class _CTDuration(CypherType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
 class _CTNode(CypherType):
     labels: FrozenSet[str] = frozenset()
 
@@ -232,6 +247,9 @@ CTNumber = _CTNumber()
 CTString = _CTString()
 CTMap = _CTMap()
 CTPath = _CTPath()
+CTDate = _CTDate()
+CTDateTime = _CTDateTime()
+CTDuration = _CTDuration()
 
 
 def CTNode(labels: Iterable[str] = ()) -> _CTNode:
@@ -269,6 +287,7 @@ def parse_type(s: str) -> CypherType:
         "CTBoolean": CTBoolean, "CTInteger": CTInteger, "CTFloat": CTFloat,
         "CTNumber": CTNumber, "CTString": CTString, "CTMap": CTMap,
         "CTPath": CTPath, "CTNode": _CTNode(), "CTRelationship": _CTRelationship(),
+        "CTDate": CTDate, "CTDateTime": CTDateTime, "CTDuration": CTDuration,
     }
     if s in simple:
         t = simple[s]
@@ -296,6 +315,12 @@ def from_python(value) -> CypherType:
         return CTFloat
     if isinstance(value, str):
         return CTString
+    if isinstance(value, v.CypherDate):
+        return CTDate
+    if isinstance(value, v.CypherDateTime):
+        return CTDateTime
+    if isinstance(value, v.CypherDuration):
+        return CTDuration
     if isinstance(value, v.CypherNode):
         return CTNode(value.labels)
     if isinstance(value, v.CypherRelationship):

@@ -57,11 +57,14 @@ def bin_positions(dest: jnp.ndarray, ok: jnp.ndarray, n_shards: int,
 
 def exchange_binned(arr: jnp.ndarray, dest: jnp.ndarray,
                     row_pos: jnp.ndarray, n_shards: int, bin_cap: int,
-                    axis: str, fill) -> jnp.ndarray:
-    """Scatter local rows into (n_shards, bin_cap) bins (out-of-range
-    destinations drop) and all_to_all: device i receives every other
-    device's bin i → (n_shards, bin_cap)."""
-    binned = jnp.full((n_shards, bin_cap), fill, arr.dtype)
+                    axis, fill) -> jnp.ndarray:
+    """Scatter local rows into (n_shards, bin_cap, *trailing) bins
+    (out-of-range destinations drop) and all_to_all: device i receives
+    every other device's bin i → (n_shards, bin_cap, *trailing).
+    Trailing dims carry matrix payloads (e.g. list columns); ``axis`` may
+    be a tuple of mesh axes (2-D DCN×ICI meshes — the collective runs
+    over the flattened product)."""
+    binned = jnp.full((n_shards, bin_cap) + arr.shape[1:], fill, arr.dtype)
     binned = binned.at[dest, jnp.clip(row_pos, 0, bin_cap - 1)].set(
         arr, mode="drop")
     return lax.all_to_all(binned, axis, split_axis=0, concat_axis=0,

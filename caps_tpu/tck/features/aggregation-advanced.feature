@@ -262,3 +262,38 @@ Feature: Aggregation edge cases
       | g   | mx |
       | 'a' | 3  |
       | 'b' | 9  |
+
+  Scenario: percentileDisc and percentileCont honour DISTINCT
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 2}), (:P {v: 2}), (:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      RETURN percentileDisc(DISTINCT p.v, 0.5) AS pd,
+             percentileCont(DISTINCT p.v, 0.5) AS pc,
+             percentileDisc(p.v, 0.5) AS pn
+      """
+    Then the result should be, in any order:
+      | pd | pc  | pn |
+      | 1  | 1.5 | 2  |
+
+  Scenario: count and collect DISTINCT over grouped entities
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:U {n: 'a'}), (b:U {n: 'b'}),
+             (a)-[:L]->(:M {t: 'x'}), (a)-[:L]->(:M {t: 'x'}),
+             (b)-[:L]->(:M {t: 'y'})
+      """
+    When executing query:
+      """
+      MATCH (u:U)-[:L]->(m:M)
+      RETURN u.n AS n, count(DISTINCT m.t) AS c, collect(DISTINCT m.t) AS ts
+      """
+    Then the result should be, in any order:
+      | n   | c | ts    |
+      | 'a' | 1 | ['x'] |
+      | 'b' | 1 | ['y'] |

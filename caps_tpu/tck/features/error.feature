@@ -31,3 +31,55 @@ Feature: Error reporting
       MATCH (n) RETURN n.x AS x ORDER BY banana
       """
     Then a SyntaxError should be raised at compile time: UndefinedVariable
+
+  Scenario: quantified predicate without WHERE is a syntax error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN all(x IN [1, 2]) AS a
+      """
+    Then a SyntaxError should be raised at compile time: InvalidSyntax
+
+  Scenario: reduce without an accumulator is a syntax error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN reduce(x IN [1, 2] | x) AS r
+      """
+    Then a SyntaxError should be raised at compile time: InvalidSyntax
+
+  Scenario: comprehension variable is not visible outside its expression
+    Given an empty graph
+    When executing query:
+      """
+      RETURN [x IN [1, 2] | x] AS l, x AS leak
+      """
+    Then a SyntaxError should be raised at compile time: UndefinedVariable
+
+  Scenario: DISTINCT inside a non-aggregating function is an error
+    Given an empty graph
+    When executing query:
+      """
+      RETURN size(DISTINCT [1, 2]) AS n
+      """
+    Then a SyntaxError should be raised at compile time: InvalidSyntax
+
+  Scenario: date with a malformed string is a runtime error
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one RETURN date('not-a-date') AS d
+      """
+    Then a TypeError should be raised at runtime: InvalidArgumentValue
+
+  Scenario: with DISTINCT, ORDER BY an unprojected expression is an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN DISTINCT p.a AS a ORDER BY p.b
+      """
+    Then a SyntaxError should be raised at compile time: InvalidSyntax

@@ -1,0 +1,165 @@
+Feature: ORDER BY edge cases
+
+  Scenario: nulls sort last ascending and first descending
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 2}), (:P), (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.v AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v    |
+      | 1    |
+      | 2    |
+      | null |
+
+  Scenario: descending puts nulls first
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 2}), (:P), (:P {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.v AS v ORDER BY v DESC
+      """
+    Then the result should be, in order:
+      | v    |
+      | null |
+      | 2    |
+      | 1    |
+
+  Scenario: mixed type ordering follows the global sort order
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND ['b', 3, true, 'a', 1.5] AS v RETURN v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v     |
+      | 'a'   |
+      | 'b'   |
+      | true  |
+      | 1.5   |
+      | 3     |
+
+  Scenario: ORDER BY an expression over a pre-projection variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {a: 1, b: 9}), (:P {a: 2, b: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.a AS a ORDER BY p.b
+      """
+    Then the result should be, in order:
+      | a |
+      | 2 |
+      | 1 |
+
+  Scenario: ORDER BY an alias shadowing a property expression
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 1}), (:P {v: 3}), (:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN -p.v AS v ORDER BY v
+      """
+    Then the result should be, in order:
+      | v  |
+      | -3 |
+      | -2 |
+      | -1 |
+
+  Scenario: multi-key sort with mixed directions
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'a', v: 1}), (:P {g: 'a', v: 2}),
+             (:P {g: 'b', v: 1})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.g AS g, p.v AS v ORDER BY g ASC, v DESC
+      """
+    Then the result should be, in order:
+      | g   | v |
+      | 'a' | 2 |
+      | 'a' | 1 |
+      | 'b' | 1 |
+
+  Scenario: ORDER BY with SKIP and LIMIT slices the sorted stream
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [5, 3, 1, 4, 2] AS v RETURN v ORDER BY v SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+
+  Scenario: ORDER BY a list column sorts elementwise
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [[1, 2], [1], [2], []] AS l RETURN l ORDER BY l
+      """
+    Then the result should be, in order:
+      | l      |
+      | []     |
+      | [1]    |
+      | [1, 2] |
+      | [2]    |
+
+  Scenario: ORDER BY booleans false before true
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [true, false, true] AS b RETURN b ORDER BY b
+      """
+    Then the result should be, in order:
+      | b     |
+      | false |
+      | true  |
+      | true  |
+
+  Scenario: ORDER BY after aggregation uses the aggregated value
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {g: 'a'}), (:P {g: 'a'}), (:P {g: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.g AS g, count(*) AS c ORDER BY c DESC, g
+      """
+    Then the result should be, in order:
+      | g   | c |
+      | 'a' | 2 |
+      | 'b' | 1 |
+
+  Scenario: ORDER BY is stable for equal keys after WITH
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [3, 1, 2] AS v WITH v ORDER BY v
+      RETURN collect(v) AS l
+      """
+    Then the result should be, in any order:
+      | l         |
+      | [1, 2, 3] |
+
+  Scenario: negative SKIP and LIMIT behave as zero
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS v RETURN v ORDER BY v SKIP 0 LIMIT 0
+      """
+    Then the result should be empty

@@ -1,0 +1,150 @@
+Feature: Path families — undirected, zero-hop, cyclic
+
+  Scenario: undirected named path matches both orientations
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 'a'})-[:T]->(:B {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH p = (x)-[:T]-(y) RETURN x.n AS x, y.n AS y, length(p) AS l
+      """
+    Then the result should be, in any order:
+      | x   | y   | l |
+      | 'a' | 'b' | 1 |
+      | 'b' | 'a' | 1 |
+
+  Scenario: zero-hop var-length path binds start node only
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 'a'})-[:T]->(:B {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH p = (x:A)-[:T*0..1]->(y)
+      RETURN y.n AS y, length(p) AS l
+      """
+    Then the result should be, in any order:
+      | y   | l |
+      | 'a' | 0 |
+      | 'b' | 1 |
+
+  Scenario: nodes of a zero-hop path is the single start node
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 'a'})
+      """
+    When executing query:
+      """
+      MATCH p = (x:A) RETURN [n IN nodes(p) | n.n] AS ns,
+                            size(relationships(p)) AS nr
+      """
+    Then the result should be, in any order:
+      | ns    | nr |
+      | ['a'] | 0  |
+
+  Scenario: cyclic pattern with repeated node variable
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n: 'a'})-[:T]->(b:B)-[:T]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x:A)-[:T]->(y)-[:T]->(x) RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: self-loop matches directed and counts once per direction undirected
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {n: 'a'})-[:T]->(a)
+      """
+    When executing query:
+      """
+      MATCH (x:A)-[:T]->(x) RETURN x.n AS n
+      """
+    Then the result should be, in any order:
+      | n   |
+      | 'a' |
+
+  Scenario: relationship isomorphism forbids reusing an edge in one match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A)-[:T]->(:B)
+      """
+    When executing query:
+      """
+      MATCH (x)-[r1:T]->(y)<-[r2:T]-(z) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+
+  Scenario: undirected var-length path does not retraverse the same edge
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 'a'})-[:T]->(:B {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (x:A)-[:T*2..2]-(y) RETURN count(*) AS c
+      """
+    Then the result should be, in any order:
+      | c |
+      | 0 |
+
+  Scenario: path value through OPTIONAL MATCH is null on no match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 'a'})
+      """
+    When executing query:
+      """
+      MATCH (x:A) OPTIONAL MATCH p = (x)-[:T]->(y)
+      RETURN x.n AS n, p IS NULL AS nop
+      """
+    Then the result should be, in any order:
+      | n   | nop  |
+      | 'a' | true |
+
+  Scenario: two named paths in one MATCH are independent values
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 'a'})-[:T]->(:B {n: 'b'})-[:S]->(:C {n: 'c'})
+      """
+    When executing query:
+      """
+      MATCH p = (x:A)-[:T]->(y), q = (y)-[:S]->(z)
+      RETURN length(p) AS lp, length(q) AS lq,
+             [n IN nodes(q) | n.n] AS qn
+      """
+    Then the result should be, in any order:
+      | lp | lq | qn         |
+      | 1  | 1  | ['b', 'c'] |
+
+  Scenario: path equality compares start and relationship sequence
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:A {n: 'a'})-[:T]->(:B {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH p = (x:A)-[:T]->(y)
+      MATCH q = (x)-[:T]->(y)
+      RETURN p = q AS eq
+      """
+    Then the result should be, in any order:
+      | eq   |
+      | true |

@@ -1,0 +1,193 @@
+Feature: Temporal values — date, datetime, duration
+
+  Scenario: date literal roundtrips through toString
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one RETURN toString(date('2020-01-15')) AS s
+      """
+    Then the result should be, in any order:
+      | s            |
+      | '2020-01-15' |
+
+  Scenario: date accessors
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      WITH date('2020-03-07') AS d
+      RETURN d.year AS y, d.month AS m, d.day AS dd
+      """
+    Then the result should be, in any order:
+      | y    | m | dd |
+      | 2020 | 3 | 7  |
+
+  Scenario: date from a component map
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN toString(date({year: 1999, month: 12, day: 31})) AS s,
+             toString(date({year: 2024})) AS t
+      """
+    Then the result should be, in any order:
+      | s            | t            |
+      | '1999-12-31' | '2024-01-01' |
+
+  Scenario: date comparison and ordering
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {n: 'a', d: date('2020-01-15')}),
+             (:E {n: 'b', d: date('2019-06-30')}),
+             (:E {n: 'c', d: date('2020-03-01')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) WHERE e.d >= date('2020-01-01')
+      RETURN e.n AS n ORDER BY e.d DESC
+      """
+    Then the result should be, in order:
+      | n   |
+      | 'c' |
+      | 'a' |
+
+  Scenario: date equality and inequality
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN date('2020-01-15') = date('2020-01-15') AS eq,
+             date('2020-01-15') = date('2020-01-16') AS ne,
+             date('2020-01-15') < date('2020-01-16') AS lt
+      """
+    Then the result should be, in any order:
+      | eq   | ne    | lt   |
+      | true | false | true |
+
+  Scenario: datetime accessors and comparison
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      WITH datetime('2020-01-15T10:30:45') AS t
+      RETURN t.year AS y, t.hour AS h, t.minute AS m, t.second AS s,
+             t < datetime('2020-01-15T11:00:00') AS lt
+      """
+    Then the result should be, in any order:
+      | y    | h  | m  | s  | lt   |
+      | 2020 | 10 | 30 | 45 | true |
+
+  Scenario: duration components from a map
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      WITH duration({years: 1, months: 2, days: 3, hours: 4}) AS du
+      RETURN du.months AS mo, du.days AS d, du.hours AS h
+      """
+    Then the result should be, in any order:
+      | mo | d | h |
+      | 14 | 3 | 4 |
+
+  Scenario: duration from an ISO 8601 string
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      WITH duration('P1Y2M3DT4H5M6S') AS du
+      RETURN du.months AS mo, du.days AS d, du.seconds AS s
+      """
+    Then the result should be, in any order:
+      | mo | d | s     |
+      | 14 | 3 | 14706 |
+
+  Scenario: date plus and minus duration
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN toString(date('2020-01-31') + duration({months: 1})) AS clamped,
+             toString(date('2020-03-06') - duration({days: 6})) AS back
+      """
+    Then the result should be, in any order:
+      | clamped      | back         |
+      | '2020-02-29' | '2020-02-29' |
+
+  Scenario: datetime plus duration crosses a day boundary
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN toString(datetime('2020-01-15T23:30:00')
+                      + duration({hours: 1})) AS t
+      """
+    Then the result should be, in any order:
+      | t                     |
+      | '2020-01-16T00:30:00' |
+
+  Scenario: temporal values stored as properties survive grouping
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E {g: 'x', d: date('2020-01-15')}),
+             (:E {g: 'x', d: date('2019-06-30')}),
+             (:E {g: 'y', d: date('2021-05-05')})
+      """
+    When executing query:
+      """
+      MATCH (e:E) RETURN e.g AS g, toString(min(e.d)) AS first,
+                         count(DISTINCT e.d) AS n
+      """
+    Then the result should be, in any order:
+      | g   | first        | n |
+      | 'x' | '2019-06-30' | 2 |
+      | 'y' | '2021-05-05' | 1 |
+
+  Scenario: null propagates through temporal constructors and arithmetic
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:E)
+      """
+    When executing query:
+      """
+      MATCH (e:E)
+      RETURN date(e.missing) AS d, e.missing + duration({days: 1}) AS p
+      """
+    Then the result should be, in any order:
+      | d    | p    |
+      | null | null |
+
+  Scenario: date and datetime are not equal to each other
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN date('2020-01-15') = datetime('2020-01-15T00:00:00') AS x
+      """
+    Then the result should be, in any order:
+      | x     |
+      | false |
+
+  Scenario: dates inside lists and comprehensions
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN [d IN [date('2020-01-15'), date('2021-05-05')] | d.year] AS ys
+      """
+    Then the result should be, in any order:
+      | ys           |
+      | [2020, 2021] |
+
+  Scenario: datetime truncation to date
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS one
+      RETURN toString(date(datetime('2020-01-15T10:30:00'))) AS d
+      """
+    Then the result should be, in any order:
+      | d            |
+      | '2020-01-15' |

@@ -42,6 +42,15 @@ def _eval_literal(expr: E.Expr, params: Mapping[str, Any]) -> Any:
     if isinstance(expr, E.MapLit):
         return {k: _eval_literal(v, params)
                 for k, v in zip(expr.keys, expr.values)}
+    if isinstance(expr, E.FunctionExpr) \
+            and expr.name in ("date", "datetime", "localdatetime",
+                              "duration"):
+        from caps_tpu.okapi.values import temporal_construct
+        try:
+            return temporal_construct(
+                expr.name, *[_eval_literal(a, params) for a in expr.args])
+        except (ValueError, TypeError) as ex:
+            raise GraphFactoryError(str(ex))
     raise GraphFactoryError(
         f"CREATE properties must be literals, got {expr!r}")
 

@@ -108,3 +108,19 @@ def test_percentile_after_filter(init_graph, run):
                   "percentileCont(n.v, 1.0) AS cmx, "
                   "percentileDisc(n.v, 0.5) AS med")
     assert rows == [{"mx": 9, "cmx": 9.0, "med": 5}]
+
+
+def test_percentile_distinct(init_graph, run):
+    # round-5: DISTINCT was silently dropped for percentiles (parser never
+    # passed it through); [1,2,2,2] p50 differs between the two semantics
+    g = init_graph("CREATE (:P {g:'x', v: 1}), (:P {g:'x', v: 2}), "
+                   "(:P {g:'x', v: 2}), (:P {g:'x', v: 2}), "
+                   "(:P {g:'y', v: 5}), (:P {g:'y', v: 5})")
+    rows = run(g, "MATCH (p:P) RETURN p.g AS g, "
+                  "percentileDisc(DISTINCT p.v, 0.5) AS pd, "
+                  "percentileCont(DISTINCT p.v, 0.5) AS pc, "
+                  "percentileDisc(p.v, 0.5) AS pn ORDER BY g")
+    assert rows == [
+        {"g": "x", "pd": 1, "pc": 1.5, "pn": 2},
+        {"g": "y", "pd": 5, "pc": 5.0, "pn": 5},
+    ]

@@ -118,3 +118,75 @@ def test_single_chip_unaffected():
     res = g.cypher(QUERIES[0])
     assert res.metrics["dist_joins"] == 0
     assert res.metrics["broadcast_joins"] == 0
+
+
+def test_auto_salt_on_skewed_keys():
+    """Round-5: hot keys must be DETECTED (no manual join_salt) and salted
+    surgically, with parity and the salted_joins metric recording it."""
+    s0 = LocalCypherSession()
+    g0 = _build(s0, hot_frac=0.5, seed=13)
+    q = ("MATCH (a:P)-[r:T]->(b:P) WHERE b.v < 5 "
+         "RETURN a.v AS av, b.v AS bv, r.w AS w")
+    want = g0.cypher(q).records.to_maps()
+    s = TPUCypherSession(config=EngineConfig(
+        mesh_shape=(8,), use_csr=False, broadcast_join_threshold=0))
+    g = _build(s, hot_frac=0.5, seed=13)
+    res = g.cypher(q)
+    assert Bag(res.records.to_maps()) == want
+    assert res.metrics["dist_joins"] > 0
+    assert res.metrics["salted_joins"] > 0, res.metrics
+    assert s.fallback_count == 0, s.backend.fallback_reasons
+
+
+def test_uniform_keys_do_not_salt():
+    """Surgical means surgical: uniform keys must not pay the salt tax."""
+    s = TPUCypherSession(config=EngineConfig(
+        mesh_shape=(8,), use_csr=False, broadcast_join_threshold=0))
+    g = _build(s)
+    res = g.cypher(QUERIES[1])
+    assert res.metrics["dist_joins"] > 0
+    assert res.metrics["salted_joins"] == 0, res.metrics
+
+
+def test_payload_bytes_bracketed_by_wire_estimate():
+    """Round-5 VERDICT item 7: the device-measured live-row payload must
+    be positive and bounded by the padded-buffer wire estimate."""
+    s = TPUCypherSession(config=EngineConfig(
+        mesh_shape=(8,), use_csr=False, broadcast_join_threshold=0))
+    g = _build(s)
+    res = g.cypher(QUERIES[1])
+    assert res.metrics["dist_joins"] > 0
+    assert 0 < res.metrics["ici_payload_bytes"] <= res.metrics["ici_bytes"], \
+        res.metrics
+
+
+def test_dist_join_on_2d_mesh():
+    """Round-5 VERDICT item 8: the radix exchange must fire on a 2-D
+    DCN x ICI mesh (tuple-axis collectives), with parity."""
+    s0 = LocalCypherSession()
+    g0 = _build(s0)
+    q = QUERIES[1]
+    want = g0.cypher(q).records.to_maps()
+    s = TPUCypherSession(config=EngineConfig(
+        mesh_shape=(2, 4), use_csr=False, broadcast_join_threshold=0))
+    g = _build(s)
+    res = g.cypher(q)
+    assert Bag(res.records.to_maps()) == want
+    assert res.metrics["dist_joins"] > 0, res.metrics
+    assert s.fallback_count == 0, s.backend.fallback_reasons
+
+
+def test_dist_join_carries_list_columns():
+    """Round-5 VERDICT item 8: list columns (e.g. var-length rel lists)
+    ride the exchange as matrix payloads instead of disabling it."""
+    s0 = LocalCypherSession()
+    g0 = _build(s0)
+    q = ("MATCH (a:P {v: 3})-[rs:T*1..2]->(b:P) "
+         "RETURN b.v AS v, size(rs) AS n")
+    want = g0.cypher(q).records.to_maps()
+    s = TPUCypherSession(config=EngineConfig(
+        mesh_shape=(8,), use_csr=False, broadcast_join_threshold=0))
+    g = _build(s)
+    res = g.cypher(q)
+    assert Bag(res.records.to_maps()) == want
+    assert s.fallback_count == 0, s.backend.fallback_reasons

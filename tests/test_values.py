@@ -47,3 +47,25 @@ def test_is_truthy():
     assert is_truthy(True)
     assert not is_truthy(False)
     assert not is_truthy(None)
+
+
+def test_duration_negative_components_render_and_arith():
+    # round-5 review: negative components must not borrow across units
+    from caps_tpu.okapi.values import CypherDate, CypherDuration
+    d = CypherDuration(0, 0, -3670)
+    assert d.iso() == "PT-1H-1M-10S"
+    assert CypherDuration(0, 0, 10).plus(
+        CypherDuration(0, 0, -40).negate().negate()).seconds == -30
+    # date +/- sub-day durations stay symmetric
+    day = CypherDate.parse("2020-03-01")
+    one_s = CypherDuration(seconds=1)
+    assert day.plus(one_s) == day
+    assert day.plus(one_s.negate()) == day
+
+
+def test_datetime_parse_offsets_normalize_to_utc():
+    from caps_tpu.okapi.values import CypherDateTime
+    a = CypherDateTime.parse("2020-01-01T12:00:00+05:00")
+    b = CypherDateTime.parse("2020-01-01T07:00:00")
+    c = CypherDateTime.parse("2020-01-01T07:00:00Z")
+    assert a == b == c
