@@ -168,7 +168,39 @@ class DeviceBackend:
                 f"has {len(sizes)}")
         v = sizes[cursor[0]]
         cursor[0] += 1
+        if isinstance(v, tuple) and v and v[0] == "__obj__":
+            raise FusedReplayMismatch(
+                "replay op sequence diverged: size consumed where a host "
+                "object was recorded")
         return v
+
+    def consume_obj(self, make):
+        """Materialize a small data-dependent HOST value (e.g. the hot-key
+        sample of the radix dist join) through the same record/replay
+        stream as sizes: eager/record mode runs ``make()`` (counting its
+        sync), replay serves the recorded value with NO device round trip
+        — fused replays stay sync-free and ``be.syncs`` stays honest."""
+        mode = self.count_mode
+        if mode is None:
+            self.syncs += 1
+            return make()
+        if mode[0] == "record":
+            self.syncs += 1
+            v = make()
+            mode[1].append(("__obj__", v))
+            return v
+        sizes, cursor = mode[1], mode[2]
+        if cursor[0] >= len(sizes):
+            raise FusedReplayMismatch(
+                f"replay consumed {cursor[0]} entries but the recording "
+                f"only has {len(sizes)}")
+        v = sizes[cursor[0]]
+        cursor[0] += 1
+        if not (isinstance(v, tuple) and v and v[0] == "__obj__"):
+            raise FusedReplayMismatch(
+                "replay op sequence diverged: host object consumed where "
+                "a size was recorded")
+        return v[1]
 
 
 class FusedReplayMismatch(RuntimeError):
@@ -320,9 +352,22 @@ class DeviceTable(Table):
         except UnsupportedOnDevice as ex:
             return self._fallback(str(ex)).with_column(
                 name, expr, header, parameters, ctype)
+        self._raise_row_errors(compiler)
         out = dict(self._cols)
         out[name] = col
         return DeviceTable(self.backend, out, self._n)
+
+    def _raise_row_errors(self, compiler: DeviceExprCompiler) -> None:
+        """Per-row runtime errors (e.g. division by zero): pay ONE host
+        sync only when the compiled expression contains an error site,
+        and raise the oracle's error class so all backends agree."""
+        if compiler.error_mask is None:
+            return
+        n_err = self.backend.consume_count(
+            compiler.error_mask.sum(dtype=jnp.int32))
+        if int(n_err):
+            from caps_tpu.backends.local.expr import ExprEvalError
+            raise ExprEvalError(compiler.error_what)
 
     # -- row ops ---------------------------------------------------------
 
@@ -339,6 +384,7 @@ class DeviceTable(Table):
                 raise UnsupportedOnDevice("filter predicate is not boolean")
         except UnsupportedOnDevice as ex:
             return self._fallback(str(ex)).filter(expr, header, parameters)
+        self._raise_row_errors(compiler)
         mask = pred.data & pred.valid & self.row_ok
         return self._compact(mask)
 
@@ -496,8 +542,11 @@ class DeviceTable(Table):
         cfg = self.backend.config
         H = cfg.join_hot_capacity
         S = min(4096, int(l_key.shape[0]))
-        sample = np.asarray(l_key[:S])
-        ok = np.asarray(l_ok[:S])
+        # one routed host materialization: record/replay-aware (a fused
+        # replay serves the recorded sample sync-free) and counted in
+        # be.syncs like every other device->host round trip
+        sample, ok = self.backend.consume_obj(
+            lambda: (np.asarray(l_key[:S]), np.asarray(l_ok[:S])))
         live = sample[ok]
         if live.shape[0] == 0:
             return np.zeros((0,), np.int64), 1

@@ -133,16 +133,32 @@ class SchemaTyper:
             lm, rm = lt.material, rt.material
             temporal = {CTDate, CTDateTime, CTDuration}
             if lm in temporal or rm in temporal:
-                nullable = lt.is_nullable or rt.is_nullable
-                if {lm, rm} == {CTDuration}:
-                    out: CypherType = CTDuration
-                elif CTDate in (lm, rm):
-                    out = CTDate
-                elif CTDateTime in (lm, rm):
-                    out = CTDateTime
-                else:
-                    out = CTAny
-                return out.nullable if nullable else out
+                # only the DEFINED temporal combinations produce values;
+                # everything else is null at runtime (_temporal_arith) and
+                # must not be typed as a guaranteed temporal
+                pair = (lm, rm)
+                out = None
+                if isinstance(e, E.Add):
+                    if pair in ((CTDate, CTDuration), (CTDuration, CTDate)):
+                        out = CTDate
+                    elif pair in ((CTDateTime, CTDuration),
+                                  (CTDuration, CTDateTime)):
+                        out = CTDateTime
+                    elif pair == (CTDuration, CTDuration):
+                        out = CTDuration
+                elif isinstance(e, E.Subtract):
+                    if pair == (CTDate, CTDuration):
+                        out = CTDate
+                    elif pair == (CTDateTime, CTDuration):
+                        out = CTDateTime
+                    elif pair == (CTDuration, CTDuration):
+                        out = CTDuration
+                if out is None:
+                    if CTAny in (lm, rm):
+                        return CTAny  # untyped operand: could be defined
+                    return CTNull
+                return out.nullable if (lt.is_nullable or rt.is_nullable) \
+                    else out
             # String/list concatenation via +
             if isinstance(e, E.Add) and (lm == CTString or rm == CTString):
                 out: CypherType = CTString

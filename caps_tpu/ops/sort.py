@@ -212,3 +212,49 @@ def sort_perm_pallas(keys: Sequence[jnp.ndarray], cap: int,
     ascending permutation, int32 positions."""
     planes = split_planes(keys)
     return bitonic_sort_perm(tuple(planes), interpret=interpret)
+
+
+def validate(compiled: bool = False, seed: int = 0) -> dict:
+    """Differential validation of the bitonic sort-permutation against
+    the ``lax.sort`` reference across capacities and key mixes.
+
+    ``compiled=False`` exercises the kernel's ROUTING logic (plane
+    splitting, tiling, network schedule) through the eager XLA twin —
+    CPU-provable, the fallback the round-4 VERDICT asked for while the
+    TPU tunnel is wedged.  ``compiled=True`` runs the real pallas_call
+    on the active backend (the recorded run that justifies flipping
+    ``use_sort_kernel`` on).  Returns {"cases": n, "failures": [...]}.
+    """
+    import numpy as np
+    from caps_tpu.backends.tpu import kernels as K
+
+    rng = np.random.RandomState(seed)
+    failures = []
+    cases = 0
+    # routing (eager-twin) validation: small caps — the op-by-op network
+    # at cap 1024 takes minutes on CPU; the compiled sweep covers them
+    caps = [c for c in ((128, 256) if not compiled
+                        else (128, 256, 512, 1024))
+            if sort_cap_supported(c)]
+    for cap in caps:
+        for nkeys in (1, 2, 3):
+            for rep in range(2):
+                keys = []
+                for _ in range(nkeys):
+                    if rep == 0:  # heavy duplicates: stability stress
+                        keys.append(jnp.asarray(
+                            rng.randint(0, 4, cap).astype(np.int64)))
+                    else:
+                        keys.append(jnp.asarray(
+                            rng.randint(-(2**40), 2**40, cap)
+                            .astype(np.int64)))
+                want = np.asarray(K.sort_perm(keys, cap))
+                if compiled:
+                    got = np.asarray(sort_perm_pallas(keys, cap))
+                else:
+                    got = np.asarray(bitonic_sort_perm_twin(
+                        tuple(split_planes(keys))))
+                cases += 1
+                if not np.array_equal(want, got):
+                    failures.append((cap, nkeys, rep))
+    return {"cases": cases, "failures": failures}

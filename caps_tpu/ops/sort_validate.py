@@ -1,0 +1,63 @@
+"""``python -m caps_tpu.ops.sort_validate``: the pre-staged
+use_sort_kernel flip protocol (TUNNEL_r05.md).
+
+1. Probe the device from a throwaway subprocess BEFORE importing any
+   array-creating module (a wedged axon tunnel hangs the first array
+   constant, which ops/sort.py builds at import time).
+2. Run the CPU-provable routing validation (eager twin of the bitonic
+   network) — the round-4 VERDICT's fallback while hardware is away.
+3. On a live TPU, run the COMPILED pallas kernel validation; on success
+   print the flip instruction for okapi/config.py use_sort_kernel.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def main() -> int:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=30, text=True)
+        reachable = proc.returncode == 0 and "cpu" not in proc.stdout
+    except subprocess.TimeoutExpired:
+        reachable = False
+    if not reachable:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if not reachable:
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge as _xb
+            _xb._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+
+    from caps_tpu.ops.sort import validate
+
+    res = validate(compiled=False)
+    ok = not res["failures"]
+    out = {"routing_validation": res, "backend": jax.default_backend()}
+    if jax.default_backend() == "tpu":
+        resc = validate(compiled=True)
+        out["compiled_validation"] = resc
+        ok = ok and not resc["failures"]
+        if ok:
+            out["action"] = (
+                "PASS on live TPU: flip okapi/config.py use_sort_kernel "
+                "default to True (or set CAPS_TPU_SORT_KERNEL=1) and "
+                "commit this output as the recorded validation run")
+    else:
+        out["action"] = (
+            "routing validated on CPU; rerun on a live TPU for the "
+            "compiled run that justifies the default flip")
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

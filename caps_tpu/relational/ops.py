@@ -609,6 +609,16 @@ class OrderByOp(RelationalOperator):
         return header, table
 
 
+def _slice_count(expr: E.Expr, parameters, what: str) -> int:
+    """SKIP/LIMIT operand: openCypher requires a non-negative integer
+    (negative literals are a SyntaxError upstream; parameters make it a
+    runtime check here)."""
+    n = int(host_eval(expr, parameters))
+    if n < 0:
+        raise ValueError(f"{what} must be a non-negative integer, got {n}")
+    return n
+
+
 class SkipOp(RelationalOperator):
     def __init__(self, context, parent, expr: E.Expr):
         super().__init__(context, [parent])
@@ -616,7 +626,8 @@ class SkipOp(RelationalOperator):
 
     def _compute(self):
         header, table = self.children[0].result
-        return header, table.skip(int(host_eval(self.expr, self.context.parameters)))
+        return header, table.skip(
+            _slice_count(self.expr, self.context.parameters, "SKIP"))
 
 
 class LimitOp(RelationalOperator):
@@ -626,7 +637,8 @@ class LimitOp(RelationalOperator):
 
     def _compute(self):
         header, table = self.children[0].result
-        return header, table.limit(int(host_eval(self.expr, self.context.parameters)))
+        return header, table.limit(
+            _slice_count(self.expr, self.context.parameters, "LIMIT"))
 
 
 class UnwindOp(RelationalOperator):

@@ -246,3 +246,51 @@ Feature: Numeric functions and arithmetic semantics
     Then the result should be, in any order:
       | a    |
       | -2.0 |
+
+  Scenario: data-dependent integer division by zero raises an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 0}), (:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN 10 / p.v AS a
+      """
+    Then a ArithmeticError should be raised
+
+  Scenario: modulo by zero raises an error
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1] AS x RETURN 5 % 0 AS a
+      """
+    Then a ArithmeticError should be raised
+
+  Scenario: division guarded by WHERE does not raise
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {v: 0}), (:P {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE p.v > 0 RETURN 10 / p.v AS a
+      """
+    Then the result should be, in any order:
+      | a |
+      | 5 |
+
+  Scenario: division by a null divisor is null not an error
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN 10 / p.missing AS a
+      """
+    Then the result should be, in any order:
+      | a    |
+      | null |

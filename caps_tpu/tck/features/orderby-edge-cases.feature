@@ -156,10 +156,26 @@ Feature: ORDER BY edge cases
       | l         |
       | [1, 2, 3] |
 
-  Scenario: negative SKIP and LIMIT behave as zero
+  Scenario: SKIP 0 LIMIT 0 yields no rows
     Given an empty graph
     When executing query:
       """
       UNWIND [1, 2, 3] AS v RETURN v ORDER BY v SKIP 0 LIMIT 0
       """
     Then the result should be empty
+
+  Scenario: negative LIMIT is an error
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS v RETURN v LIMIT -1
+      """
+    Then a SyntaxError should be raised at compile time: NegativeIntegerArgument
+
+  Scenario: negative SKIP is an error
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2, 3] AS v RETURN v SKIP -2
+      """
+    Then a SyntaxError should be raised at compile time: NegativeIntegerArgument
