@@ -56,9 +56,10 @@ def mask_count(mask: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def sort_right(r_key, r_ok):
-    """Sort the join build side once; cacheable per (column, live-count)
-    so repeated probes of a static scan table (every Expand hop joins the
-    same relationship table) skip the O(n log²n) re-sort."""
+    """Reference build-side sort (lax.sort, un-gated).  The engine routes
+    build-side sorts through DeviceTable._sort_perm so they can ride the
+    bitonic kernel under use_sort_kernel; this stays as the plain-XLA
+    reference the kernel differential tests probe against."""
     cap_r = r_key.shape[0]
     rk = jnp.where(r_ok, r_key.astype(jnp.int64), _R_NULL)
     rk_sorted, perm = jax.lax.sort((rk, jnp.arange(cap_r)), num_keys=1)
@@ -73,14 +74,6 @@ def probe_count(l_key, l_ok, rk_sorted):
     hi = jnp.searchsorted(rk_sorted, lk, side="right")
     counts = jnp.where(l_ok, hi - lo, 0)
     return counts, lo
-
-
-@jax.jit
-def join_count(l_key, l_ok, r_key, r_ok):
-    """Phase 1 without caching: sort the right side, then probe."""
-    rk_sorted, perm = sort_right(r_key, r_ok)
-    counts, lo = probe_count(l_key, l_ok, rk_sorted)
-    return counts, lo, perm
 
 
 @functools.partial(jax.jit, static_argnames=("out_cap", "left_join"))

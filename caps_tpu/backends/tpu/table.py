@@ -437,7 +437,12 @@ class DeviceTable(Table):
         if cached is not None and cached[0] == key:
             return cached[1]
         r_ok = rcol.valid & other.row_ok
-        res = K.sort_right(self._join_key(rcol, side="r"), r_ok)
+        rk = jnp.where(r_ok, self._join_key(rcol, side="r"), K._R_NULL)
+        # route through the shared sort gate so the build-side sort rides
+        # the bitonic kernel when use_sort_kernel is on (same fallback to
+        # lax.sort otherwise) — the last sort site outside _sort_perm
+        perm = other._sort_perm([rk])
+        res = (rk[perm], perm)
         rcol._join_sort = (key, res)
         return res
 

@@ -98,7 +98,10 @@ def _exchange_step(planes: List[jnp.ndarray], i_mat: jnp.ndarray,
         gt = gt | (eq & (a > b))
         eq = eq & (a == b)
     take_min = ((i_mat & d) == 0) ^ (dir_bit == 1)
-    sel_p = jnp.where(take_min, gt, ~gt)
+    # NOT jnp.where(take_min, gt, ~gt): a select over BOOL operands
+    # lowers through an i8->i1 vector trunci Mosaic rejects on TPU
+    # (observed live, TUNNEL_r05.md probe 4); the XOR form is identical.
+    sel_p = ~(gt ^ take_min)
     return [jnp.where(sel_p, pb, pa) for pa, pb in zip(planes, partners)]
 
 
@@ -179,7 +182,11 @@ def bitonic_sort_perm(planes: Tuple[jnp.ndarray, ...],
     tiles = tiles + [r_iota + rows * c_iota]  # position payload/tiebreak
     kernel = functools.partial(_stage_kernel, rows=rows,
                                total_levels=total_levels)
-    whole = pl.BlockSpec((rows, LANES), lambda m, j: (0, 0))
+    # index map must yield i32: under this module's x64 mode plain
+    # Python 0s trace as i64 and Mosaic rejects the (i64,i64) return
+    # (observed live on TPU, TUNNEL_r05.md probe 4)
+    whole = pl.BlockSpec((rows, LANES),
+                         lambda m, j: (jnp.int32(0), jnp.int32(0)))
     outs = pl.pallas_call(
         kernel,
         grid=(total_levels, total_levels),
