@@ -83,6 +83,10 @@ class DeviceBackend:
         self.fallbacks = 0
         self.fallback_reasons: List[str] = []
         self.syncs = 0  # device->host scalar materializations (perf metric)
+        # set after a compiled dense-group kernel fails at runtime: later
+        # group-bys skip straight to the sorted path instead of re-paying
+        # (and re-risking) a failing remote compile
+        self.dense_group_dead = False
         # Distributed-join accounting (SURVEY.md §5.5/§5.8): bytes moved
         # over ICI by hand-scheduled collectives (static shape estimates:
         # each exchanged/gathered buffer counted once per hop it crosses),
@@ -853,7 +857,25 @@ class DeviceTable(Table):
 
     def _group_device(self, by: Sequence[str],
                       aggs: Sequence[AggSpec]) -> "DeviceTable":
-        fast = self._group_dense_pallas(by, aggs)
+        try:
+            fast = (None if self.backend.dense_group_dead
+                    else self._group_dense_pallas(by, aggs))
+        except (UnsupportedOnDevice, FusedReplayMismatch):
+            raise  # routed by group() / the fused executor, not this net
+        except Exception as ex:
+            # a compiled-kernel failure at an unprobed shape must degrade
+            # to the sorted path, never crash the query (the probe gates
+            # representative shapes, not every (rows, segments) pair; an
+            # LDBC run crashed exactly here before the round-5 probe
+            # rework).  Mosaic lowering errors subclass plain Exception,
+            # not JaxRuntimeError, hence the broad catch.  The kill flag
+            # stops later group-bys from re-paying a failing remote
+            # compile (each failed compile also risks wedging the tunnel
+            # — TUNNEL_r05.md probes #5/#7).
+            self.backend.dense_group_dead = True
+            self.backend.fallback_reasons.append(
+                f"dense group kernel failed at runtime: {str(ex)[:200]}")
+            fast = None
         if fast is not None:
             return fast
         cap = self.capacity

@@ -54,12 +54,13 @@ class EngineConfig:
         default_factory=lambda: _env_bool("CAPS_TPU_USE_PALLAS", True))
     # Bitonic sort-permutation kernel (ops/sort.py) for order_by /
     # distinct / group sorts on supported tile capacities (compiled TPU
-    # only; rides use_pallas + the probe's "sort" family).  Default OFF:
-    # compiled-path validation on the live TPU stack is still pending
-    # (the tunnel wedged mid-validation); flip on once a recorded
-    # compile+parity run exists for the active jaxlib.
+    # only; rides use_pallas + the probe's "sort" family).  Default ON:
+    # validated on live TPU v5e 2026-07-31 (``python -m
+    # caps_tpu.ops.sort_validate``: 18 compiled cases, 0 failures —
+    # recorded in TUNNEL_r05.md probe #6).  CAPS_TPU_SORT_KERNEL=0
+    # restores the lax.sort path.
     use_sort_kernel: bool = dataclasses.field(
-        default_factory=lambda: _env_bool("CAPS_TPU_SORT_KERNEL", False))
+        default_factory=lambda: _env_bool("CAPS_TPU_SORT_KERNEL", True))
     # HBM-resident CSR adjacency as the relationship scan's physical
     # layout (ops/expand.py DeviceCSR); joins against it probe indptr
     # instead of sorting + binary-searching the edge table.

@@ -11,8 +11,9 @@ kernel per FEATURE FAMILY, each in its OWN subprocess (immune to compiler
 hangs, and a hang in one family cannot condemn the others), and caches
 per-family verdicts on disk per jaxlib version:
 
-    basic    — plain grid, full-array/2-D blocks, iota/compare/reduce
-               (segment histogram, join-expand positions)
+    basic    — the REAL segment-histogram kernel at a multi-row-tile
+               shape (1-D blocked operands; a single-block mini-kernel
+               passed while blocked operands failed on v5e)
     prefetch — PrefetchScalarGridSpec with data-dependent block indexing
                (the CSR expand-positions kernel)
     sort     — grid-stepped compare-exchange with sublane reshape/concat
@@ -20,10 +21,11 @@ per-family verdicts on disk per jaxlib version:
 
 A subprocess that failed WITHOUT a Pallas/Mosaic-shaped error (e.g. it
 could not acquire an exclusively-held device) does not condemn the
-family — the probe retries in-process, where only quick failure modes
-can occur (hang-prone families skip the retry and stay unknown=False for
-this process WITHOUT writing the disk cache, so a healthy later process
-re-probes).
+family — it stays unknown=False for this process WITHOUT writing the
+disk cache, so a healthy later process re-probes.  (No family retries
+in-process anymore: every probe now compiles a real kernel, and an
+in-process compile has no hang protection — a hung remote compile would
+wedge the engine process itself.)
 """
 from __future__ import annotations
 
@@ -47,18 +49,33 @@ from jax.experimental.pallas import tpu as pltpu
 """
 
 _PROBE_SRCS = {
-    # plain grid + iota/compare/reduce (segment aggregation shape)
+    # the real segment-histogram kernel at a MULTI-row-tile shape — a
+    # single-block mini-kernel passed here while the real kernel's
+    # blocked operands failed layout verification on the live stack
+    # (1-D blocks < T(1024)), so probe the thing itself, like "sort"
     "basic": _COMMON + r"""
-def k1(x_ref, o_ref):
-    t = jax.lax.broadcasted_iota(jnp.int32, (256, 128), 1)
-    offs = x_ref[:].reshape(256, 1)
-    o_ref[:] = jnp.sum((offs <= t).astype(jnp.int32), axis=1,
-                       dtype=jnp.int32)
-x = jnp.arange(256, dtype=jnp.int32)
-out = pl.pallas_call(k1, out_shape=jax.ShapeDtypeStruct((256,), jnp.int32))(x)
-out.block_until_ready()
+import sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+from caps_tpu.ops.segment import dense_segment_agg, dense_segment_agg_ref
+rng = np.random.RandomState(0)
+# two shapes so BOTH output tilings compile: segs=130 -> one whole-array
+# 256-slot block; segs=1500 -> seg_tile 1024, TWO segment tiles.  n=4096
+# -> four 1024-row tiles.  Two kinds cover the sum/accumulate and the
+# min/max reduce codegen paths.
+for segs, kind in ((130, "count"), (1500, "max_f32")):
+    n = 4096
+    codes = jnp.asarray(rng.randint(0, segs, n).astype(np.int32))
+    ok = jnp.asarray(rng.rand(n) < 0.9)
+    vals = jnp.asarray(rng.randn(n).astype(np.float32))
+    got = dense_segment_agg(codes, ok, vals, segs, kind, interpret=False)
+    got.block_until_ready()
+    want = dense_segment_agg_ref(codes, ok, vals, segs, kind)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-5)
 print("PALLAS_PROBE_OK", flush=True)
-""",
+""" % {"repo": os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))},
     # scalar-prefetch grid with data-dependent block indexing
     "prefetch": _COMMON + r"""
 def k2(blk_ref, x_ref, o_ref):
@@ -103,9 +120,12 @@ print("PALLAS_PROBE_OK", flush=True)
 
 _MARKER = "PALLAS_PROBE_OK"
 
-# families safe to retry in-process (fail fast, no observed hangs)
-_INPROCESS_RETRY = ("basic",)
-
+# No family retries in-process (the old basic-family retry was removed
+# when its probe became the real multi-tile segment kernel: an
+# in-process compile has no hang protection, and a hung remote compile
+# wedges the whole engine process — TUNNEL_r05.md probes #5/#7).  A
+# non-conclusive subprocess failure leaves the family unknown=False for
+# this process (twins, no disk write); a healthy later process re-probes.
 _PALLAS_ERR_MARKERS = ("pallas", "mosaic", "RecursionError",
                        "remote_compile", "tpu_compile")
 
@@ -114,7 +134,7 @@ def _cache_path() -> str:
     import jaxlib
     ver = getattr(jaxlib, "__version__", "unknown")
     return os.path.join(os.path.expanduser("~"), ".cache",
-                        f"caps_tpu_pallas_probe3_{ver}.json")
+                        f"caps_tpu_pallas_probe4_{ver}.json")
 
 
 def _probe_family(feature: str, timeout_s: float):
@@ -130,39 +150,12 @@ def _probe_family(feature: str, timeout_s: float):
         err = (proc.stderr or "") + (proc.stdout or "")
         pallas_shaped = any(m.lower() in err.lower()
                             for m in _PALLAS_ERR_MARKERS)
-        if not pallas_shaped and feature in _INPROCESS_RETRY:
-            ok, reason = _probe_basic_inprocess()
-            return ok, reason, True
         return False, err[-400:], pallas_shaped
     except subprocess.TimeoutExpired:
         # a compiler hang IS a verdict for the hang-prone families
         return False, f"probe timed out after {timeout_s}s", True
     except Exception as ex:  # environment failure — not conclusive
         return False, str(ex)[:400], False
-
-
-def _probe_basic_inprocess():
-    """Last-resort basic-family probe in this process (no hang
-    protection; used only when the subprocess failed for reasons
-    unrelated to Pallas, e.g. device contention)."""
-    try:
-        import jax
-        import jax.numpy as jnp
-        from jax.experimental import pallas as pl
-
-        def k1(x_ref, o_ref):
-            t = jax.lax.broadcasted_iota(jnp.int32, (256, 128), 1)
-            offs = x_ref[:].reshape(256, 1)
-            o_ref[:] = jnp.sum((offs <= t).astype(jnp.int32), axis=1,
-                               dtype=jnp.int32)
-
-        x = jnp.arange(256, dtype=jnp.int32)
-        pl.pallas_call(
-            k1, out_shape=jax.ShapeDtypeStruct((256,), jnp.int32)
-        )(x).block_until_ready()
-        return True, ""
-    except Exception as ex:
-        return False, str(ex)[:400]
 
 
 _SANE: Optional[bool] = None

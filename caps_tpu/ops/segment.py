@@ -31,8 +31,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-ROW_TILE = 512
-SEG_TILE = 128
+# Blocks are 1-D; the live TPU stack verifies Mosaic's derived layout
+# against XLA's, and XLA tiles a 1-D 32-bit operand of padded size S at
+# T(min(1024, S)) — so every 1-D block (inputs AND output) must be
+# exactly min(1024, padded_array_size) or Mosaic is rejected with
+# "XLA layout ({0:T(1024)}) does not match Mosaic layout ({0:T(512)})"
+# (observed on v5e 2026-07-31 at s32[4096]/block 512 and s32[256]/block
+# 128).  Rows therefore pad to 1024 multiples with a fixed 1024 tile;
+# the segment axis uses ONE whole-array block up to 1024 and 1024-tiles
+# beyond.
+ROW_TILE = 1024
+SEG_QUANTUM = 128
 
 _KINDS = ("count", "sum_f32", "sum_i32", "min_i32", "max_i32",
           "min_f32", "max_f32")
@@ -71,8 +80,13 @@ def _agg_kernel(codes_ref, ok_ref, val_ref, out_ref, *, kind: str,
         part = jnp.sum(hit.astype(jnp.int32), axis=0, dtype=jnp.int32)
     elif kind == "sum_f32":
         v = jnp.where(ok_ref[:] != 0, val_ref[:], jnp.float32(0))
+        # HIGHEST: the MXU's default f32 precision truncates operands to
+        # bf16, which is visible data loss in an aggregate (observed
+        # ~2e-2 abs drift on live v5e); bf16x6 passes restore f32 sums
         part = jnp.dot(v.reshape(1, row_tile), hit.astype(jnp.float32),
-                       preferred_element_type=jnp.float32).reshape(seg_tile)
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST
+                       ).reshape(seg_tile)
     elif kind == "sum_i32":
         v = val_ref[:].reshape(row_tile, 1)
         part = jnp.sum(jnp.where(hit, v, jnp.int32(0)), axis=0,
@@ -126,7 +140,7 @@ def dense_segment_agg(codes: jnp.ndarray, ok: jnp.ndarray,
     if n == 0:
         ident = _IDENT.get(kind, 0)
         return jnp.full((num_segments,), ident, _out_dtype(kind))
-    row_tile = min(ROW_TILE, max(128, 1 << (n - 1).bit_length()))
+    row_tile = ROW_TILE  # fixed: sub-1024 1-D blocks fail layout checks
     codes_p = _pad1(codes.astype(jnp.int32), row_tile, -1)
     ok_p = _pad1(ok.astype(jnp.int32), row_tile, 0)
     if kind == "count":
@@ -134,11 +148,16 @@ def dense_segment_agg(codes: jnp.ndarray, ok: jnp.ndarray,
     else:
         want = jnp.float32 if kind.endswith("f32") else jnp.int32
         vals_p = _pad1(values.astype(want), row_tile, 0)
-    seg_pad = ((num_segments + SEG_TILE - 1) // SEG_TILE) * SEG_TILE
+    seg_pad = ((num_segments + SEG_QUANTUM - 1) // SEG_QUANTUM) * SEG_QUANTUM
+    if seg_pad > 1024:
+        seg_tile = 1024
+        seg_pad = ((seg_pad + 1023) // 1024) * 1024
+    else:
+        seg_tile = seg_pad  # single whole-array output block
     n_pad = codes_p.shape[0]
-    grid = (seg_pad // SEG_TILE, n_pad // row_tile)
+    grid = (seg_pad // seg_tile, n_pad // row_tile)
     kernel = functools.partial(_agg_kernel, kind=kind, row_tile=row_tile,
-                               seg_tile=SEG_TILE)
+                               seg_tile=seg_tile)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -150,7 +169,7 @@ def dense_segment_agg(codes: jnp.ndarray, ok: jnp.ndarray,
             pl.BlockSpec((row_tile,), lambda j, i: (i,),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((SEG_TILE,), lambda j, i: (j,),
+        out_specs=pl.BlockSpec((seg_tile,), lambda j, i: (j,),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((seg_pad,), _out_dtype(kind)),
         interpret=interpret,

@@ -22,7 +22,10 @@ def _case(rng, n, s):
 
 @pytest.mark.parametrize("kind", KINDS)
 @pytest.mark.parametrize("n,s", [(1000, 7), (513, 130), (4096, 1),
-                                 (100, 300), (1, 1)])
+                                 (100, 300), (1, 1),
+                                 # multi-row-tile AND multi-segment-tile
+                                 # (s > 1024 -> seg_tile 1024, grid j > 1)
+                                 (5000, 1500)])
 def test_dense_segment_agg_matches_ref(kind, n, s):
     # NB: deterministic seed — hash() is salted per process.
     rng = np.random.RandomState((len(kind) * 1009 + n * 31 + s) % 2**31)
