@@ -41,7 +41,8 @@ class TPUCypherSession(RelationalCypherSession):
         be = self.backend
         before = (be.ici_bytes, be.dist_joins, be.broadcast_joins,
                   be.fallbacks, be.syncs, be.ici_payload_bytes,
-                  be.salted_joins)
+                  be.salted_joins, self.fused.generic_replays
+                  if self.config.use_fused else 0)
         if not self.config.use_fused:
             result = super()._cypher_on_graph(graph, query, parameters)
         else:
@@ -58,6 +59,9 @@ class TPUCypherSession(RelationalCypherSession):
             result.metrics["ici_payload_bytes"] = \
                 be.ici_payload_bytes - before[5]
             result.metrics["salted_joins"] = be.salted_joins - before[6]
+            if self.config.use_fused:
+                result.metrics["fused_generic_replays"] = \
+                    self.fused.generic_replays - before[7]
         return result
 
     @property

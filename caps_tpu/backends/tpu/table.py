@@ -87,6 +87,10 @@ class DeviceBackend:
         # group-bys skip straight to the sorted path instead of re-paying
         # (and re-risking) a failing remote compile
         self.dense_group_dead = False
+        # device bool scalar accumulated by generic-replay relation
+        # checks (consume_count/_rows); the fused executor syncs it once
+        # per query and re-records on violation
+        self._replay_viol = None
         # Distributed-join accounting (SURVEY.md §5.5/§5.8): bytes moved
         # over ICI by hand-scheduled collectives (static shape estimates:
         # each exchanged/gathered buffer counted once per hop it crosses),
@@ -154,8 +158,25 @@ class DeviceBackend:
     def bucket(self, n: int) -> int:
         return max(1, self.config.bucket_for(n))
 
-    def consume_count(self, dev_scalar) -> int:
-        """Materialize a data-dependent size (see ``count_mode``)."""
+    def consume_count(self, dev_scalar, relation: str = "exact") -> int:
+        """Materialize a data-dependent size (see ``count_mode``).
+
+        ``relation`` declares how the caller uses the value, so a
+        param-GENERIC replay (fused.py) can serve sizes recorded for
+        *different* parameter values and still stay exact:
+
+        * ``"cap"``   — an upper bound (capacity/bucket/width choice);
+          serving any value ≥ the actual one is correct.
+        * ``"lo"``    — a lower bound (e.g. a domain minimum); serving
+          any value ≤ the actual one is correct.
+        * ``"exact"`` — semantics depend on the exact value (error
+          counts, retry predicates); a generic replay must re-execute
+          when the actual value differs.
+        * ``"stat"``  — metrics only; any served value is acceptable.
+
+        Under generic replay the relation is CHECKED on device (no sync):
+        a violation raises the end-of-query re-record, so a wrong served
+        value can never reach results."""
         mode = self.count_mode
         if mode is None:
             self.syncs += 1
@@ -163,20 +184,100 @@ class DeviceBackend:
         if mode[0] == "record":
             self.syncs += 1
             v = int(dev_scalar)
-            mode[1].append(v)
+            mode[1].append(("size", v, relation))
             return v
-        sizes, cursor = mode[1], mode[2]
-        if cursor[0] >= len(sizes):
+        v = self._next_entry(mode, "size")
+        if mode[0] == "replay_gen":
+            if v[2] != relation:
+                raise FusedReplayMismatch(
+                    f"generic replay relation mismatch: recorded {v[2]}, "
+                    f"consumed as {relation}")
+            self._accumulate_violation(dev_scalar, v[1], relation)
+        return v[1]
+
+    @staticmethod
+    def _next_entry(mode, tag: str):
+        """Pop the next record/replay stream entry, validating its tag —
+        any misalignment means the op sequence diverged from the
+        recording."""
+        entries, cursor = mode[1], mode[2]
+        if cursor[0] >= len(entries):
             raise FusedReplayMismatch(
-                f"replay consumed {cursor[0]} sizes but the recording only "
-                f"has {len(sizes)}")
-        v = sizes[cursor[0]]
+                f"replay consumed {cursor[0]} entries but the recording "
+                f"only has {len(entries)}")
+        v = entries[cursor[0]]
         cursor[0] += 1
-        if isinstance(v, tuple) and v and v[0] == "__obj__":
+        if not (isinstance(v, tuple) and v and v[0] == tag):
             raise FusedReplayMismatch(
-                "replay op sequence diverged: size consumed where a host "
-                "object was recorded")
+                f"replay op sequence diverged: {tag} consumed where "
+                f"{v[0] if isinstance(v, tuple) else type(v)} was recorded")
         return v
+
+    def consume_rows(self, dev_scalar):
+        """Like :meth:`consume_count` for a table's LIVE ROW COUNT:
+        returns ``(n, live)`` where ``n`` is the host row count and
+        ``live`` is ``None`` in eager/record/exact-replay mode.  Under
+        generic replay ``n`` is a served upper bound and ``live`` is the
+        exact device scalar — the caller must attach it to the produced
+        table (``DeviceTable(..., live=live)``) so ``row_ok`` stays
+        exact without a sync."""
+        mode = self.count_mode
+        if mode is None:
+            self.syncs += 1
+            return int(dev_scalar), None
+        if mode[0] == "record":
+            self.syncs += 1
+            v = int(dev_scalar)
+            mode[1].append(("rows", v))
+            return v, None
+        v = self._next_entry(mode, "rows")
+        if mode[0] == "replay_gen":
+            self._accumulate_violation(dev_scalar, v[1], "cap")
+            return v[1], jnp.asarray(dev_scalar).astype(jnp.int32)
+        return v[1], None
+
+    def consume_pred(self, host_value: bool, dev_thunk) -> bool:
+        """A host BRANCH PREDICATE routed through the record/replay
+        stream.  Never syncs: the host value is exactly known in
+        eager/record mode, replay serves the recorded branch, and
+        generic replay additionally checks ``dev_thunk()`` (a device
+        bool of the actual predicate) against it — a divergent branch
+        trips the end-of-query violation and re-records.  Without this,
+        a host `if table.size == 0:` would silently follow the recorded
+        branch when the actual emptiness differs (served sizes are only
+        upper bounds)."""
+        mode = self.count_mode
+        if mode is None:
+            return host_value
+        if mode[0] == "record":
+            mode[1].append(("size", int(host_value), "exact"))
+            return host_value
+        v = self._next_entry(mode, "size")
+        if v[2] != "exact":
+            raise FusedReplayMismatch(
+                f"replay op sequence diverged: branch predicate consumed "
+                f"where a {v[2]} size was recorded")
+        if mode[0] == "replay_gen":
+            self._accumulate_violation(
+                jnp.asarray(dev_thunk()).astype(jnp.int64), v[1], "exact")
+        return bool(v[1])
+
+    def _accumulate_violation(self, dev_scalar, served: int,
+                              relation: str) -> None:
+        """Device-side relation check for generic replay: ORs into
+        ``_replay_viol``, synced ONCE at the end of the query."""
+        if relation == "stat":
+            return
+        actual = jnp.asarray(dev_scalar).astype(jnp.int64)
+        served64 = jnp.int64(served)
+        if relation == "cap":
+            bad = actual > served64
+        elif relation == "lo":
+            bad = actual < served64
+        else:  # exact
+            bad = actual != served64
+        self._replay_viol = (bad if self._replay_viol is None
+                             else self._replay_viol | bad)
 
     def consume_obj(self, make):
         """Materialize a small data-dependent HOST value (e.g. the hot-key
@@ -193,18 +294,7 @@ class DeviceBackend:
             v = make()
             mode[1].append(("__obj__", v))
             return v
-        sizes, cursor = mode[1], mode[2]
-        if cursor[0] >= len(sizes):
-            raise FusedReplayMismatch(
-                f"replay consumed {cursor[0]} entries but the recording "
-                f"only has {len(sizes)}")
-        v = sizes[cursor[0]]
-        cursor[0] += 1
-        if not (isinstance(v, tuple) and v and v[0] == "__obj__"):
-            raise FusedReplayMismatch(
-                "replay op sequence diverged: host object consumed where "
-                "a size was recorded")
-        return v[1]
+        return self._next_entry(mode, "__obj__")[1]
 
 
 class FusedReplayMismatch(RuntimeError):
@@ -214,11 +304,19 @@ class FusedReplayMismatch(RuntimeError):
 class DeviceTable(Table):
     def __init__(self, backend: DeviceBackend,
                  columns: Optional[Dict[str, Column]] = None, n: int = 0,
-                 local: Optional[LocalTable] = None):
+                 local: Optional[LocalTable] = None,
+                 live: Optional[jnp.ndarray] = None):
         self.backend = backend
         self._cols: Dict[str, Column] = dict(columns or {})
         self._n = n
         self._local = local  # non-None → host-fallback mode
+        # Generic-replay mode (fused.py): ``n`` is a SERVED upper bound
+        # and ``live`` is the exact live-row count as a device scalar —
+        # live rows always form a prefix (every producer compacts or
+        # expands live-first), so row_ok stays exact with zero syncs.
+        # None in eager/record mode, where ``n`` is exact.
+        self._live = live
+        self._exact_cache: Optional[int] = None  # memoized int(_live)
 
     # -- mode handling -------------------------------------------------
 
@@ -229,11 +327,12 @@ class DeviceTable(Table):
     def to_local(self) -> LocalTable:
         if self._local is not None:
             return self._local
-        data = {c: column_to_host(col, self._n, self.backend.pool)
+        n = self._exact_n()
+        data = {c: column_to_host(col, n, self.backend.pool)
                 for c, col in self._cols.items()}
         types = {c: col.ctype for c, col in self._cols.items()}
         return LocalTable(tuple(self._cols.keys()), data, types,
-                          size=self._n)
+                          size=n)
 
     def _fallback(self, reason: str) -> "DeviceTable":
         self.backend.fallbacks += 1
@@ -257,7 +356,45 @@ class DeviceTable(Table):
 
     @property
     def row_ok(self) -> jnp.ndarray:
-        return K.row_mask(self.capacity, self._n)
+        m = K.row_mask(self.capacity, self._n)
+        if self._live is not None:
+            m = m & (jnp.arange(self.capacity) < self._live)
+        return m
+
+    def _with_cols(self, columns: Dict[str, Column]) -> "DeviceTable":
+        """Row-preserving rebuild: same n and live count."""
+        return DeviceTable(self.backend, columns, self._n, live=self._live)
+
+    def _exact_n(self) -> int:
+        """The exact live row count as a host int.  Free in eager mode;
+        under generic replay this is a sync (counted), used only at
+        materialization boundaries (to_local)."""
+        if self._live is None:
+            return self._n
+        if self._exact_cache is None:
+            self.backend.syncs += 1
+            self._exact_cache = int(self._live)
+        return self._exact_cache
+
+    def exact_size(self) -> int:
+        if self._local is not None:
+            return self._local.size
+        return self._exact_n()
+
+    def size_hint(self) -> int:
+        if self._local is not None:
+            return self._local.size
+        if self._exact_cache is not None:
+            return self._exact_cache
+        return self._n
+
+    def branch_empty(self) -> bool:
+        if self._local is not None:
+            return self._local.size == 0
+        return self.backend.consume_pred(
+            self._n == 0,
+            lambda: (self._live if self._live is not None
+                     else jnp.int32(self._n)) == 0)
 
     # -- shape ----------------------------------------------------------
 
@@ -300,8 +437,7 @@ class DeviceTable(Table):
         missing = [c for c in cols if c not in self._cols]
         if missing:
             raise KeyError(f"missing columns {missing}; have {self.columns}")
-        return DeviceTable(self.backend, {c: self._cols[c] for c in cols},
-                           self._n)
+        return self._with_cols({c: self._cols[c] for c in cols})
 
     def rename(self, mapping: Mapping[str, str]) -> "DeviceTable":
         if self._local is not None:
@@ -309,14 +445,14 @@ class DeviceTable(Table):
         out = {mapping.get(c, c): col for c, col in self._cols.items()}
         if len(out) != len(self._cols):
             raise ValueError(f"rename collision: {mapping}")
-        return DeviceTable(self.backend, out, self._n)
+        return self._with_cols(out)
 
     def copy_column(self, src: str, dst: str) -> "DeviceTable":
         if self._local is not None:
             return self._wrap_local(self._local.copy_column(src, dst))
         out = dict(self._cols)
         out[dst] = self._cols[src]
-        return DeviceTable(self.backend, out, self._n)
+        return self._with_cols(out)
 
     def with_literal_column(self, name, value, ctype) -> "DeviceTable":
         if self._local is not None:
@@ -331,7 +467,7 @@ class DeviceTable(Table):
                 name, value, ctype)
         out = dict(self._cols)
         out[name] = col
-        return DeviceTable(self.backend, out, self._n)
+        return self._with_cols(out)
 
     def with_row_index(self, name: str) -> "DeviceTable":
         if self._local is not None:
@@ -341,7 +477,7 @@ class DeviceTable(Table):
                    jnp.ones(self.capacity, bool), CTInteger))
         out = dict(self._cols)
         out[name] = col
-        return DeviceTable(self.backend, out, self._n)
+        return self._with_cols(out)
 
     def with_column(self, name, expr: Expr, header: RecordHeader,
                     parameters, ctype) -> "DeviceTable":
@@ -359,7 +495,7 @@ class DeviceTable(Table):
         self._raise_row_errors(compiler)
         out = dict(self._cols)
         out[name] = col
-        return DeviceTable(self.backend, out, self._n)
+        return self._with_cols(out)
 
     def _raise_row_errors(self, compiler: DeviceExprCompiler) -> None:
         """Per-row runtime errors (e.g. division by zero): pay ONE host
@@ -393,11 +529,13 @@ class DeviceTable(Table):
         return self._compact(mask)
 
     def _compact(self, mask: jnp.ndarray) -> "DeviceTable":
-        new_n = self.backend.consume_count(K.mask_count(mask))
+        count = K.mask_count(mask)
+        new_n, live = self.backend.consume_rows(count)
         out_cap = self.backend.bucket(new_n)
         idx, _ = K.compact_indices(mask, out_cap)
         idx = self.backend.place_rows(idx)
-        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx),
+                           new_n, live=live)
 
     def join(self, other: Table, how: str,
              pairs: Sequence[Tuple[str, str]]) -> "DeviceTable":
@@ -490,7 +628,8 @@ class DeviceTable(Table):
             rk_sorted, perm = self._cached_right_sort(other, rcol)
             counts, lo = K.probe_count(self._masked_left_key(lcol), l_ok,
                                        rk_sorted)
-        total = self.backend.consume_count(K.join_total(counts, l_ok, left_join))
+        total_dev = K.join_total(counts, l_ok, left_join)
+        total, live = self.backend.consume_rows(total_dev)
         out_cap = self.backend.bucket(total)
         if self.backend.config.use_pallas and OPS.pallas_usable("prefetch"):
             l_idx, r_idx, out_valid, r_matched = OPS.join_expand_via_positions(
@@ -506,7 +645,7 @@ class DeviceTable(Table):
         for c, col in right.items():
             out_cols[c] = Column(col.kind, col.data, col.valid & r_matched,
                                  col.ctype, col.lens)
-        out = DeviceTable(self.backend, out_cols, total)
+        out = DeviceTable(self.backend, out_cols, total, live=live)
         return out._extra_pair_filter(pairs, left_join)
 
     def _extra_pair_filter(self, pairs: Sequence[Tuple[str, str]],
@@ -649,7 +788,7 @@ class DeviceTable(Table):
                                            1, left_join, True)
             (max_total, live_r) = prog1(l_key, l_ok, r_key, r_ok,
                                         *l_arrs, *r_arrs)
-            out_cap_dev = be.bucket(max(1, be.consume_count(max_total)))
+            out_cap_dev = be.bucket(max(1, be.consume_count(max_total, relation="cap")))
             prog2 = DJ.make_broadcast_join(be.mesh, axis, n_l, n_r,
                                            out_cap_dev, left_join, False)
             res = prog2(l_key, l_ok, r_key, r_ok, *l_arrs, *r_arrs)
@@ -662,7 +801,7 @@ class DeviceTable(Table):
             # live_r = global live build rows; each is gathered to the
             # other n-1 devices (same convention as the wire estimate)
             be.ici_payload_bytes += (KEY_OK_BYTES + row_bytes(r_arrs)) \
-                * be.consume_count(live_r) * (n - 1)
+                * be.consume_count(live_r, relation="stat") * (n - 1)
             be.broadcast_joins += 1
         else:
             manual = cfg.join_salt > 1
@@ -704,7 +843,7 @@ class DeviceTable(Table):
                     + row_bytes(r_arrs)
                     * (bin_cap + (salt - 1) * hot_bin_cap)
                 ) * n * (n - 1)
-                if be.consume_count(dropped) == 0:
+                if be.consume_count(dropped, relation="exact") == 0:
                     break
                 if bin_cap >= local_cap and hot_bin_cap >= local_cap:
                     return None  # safe bound exceeded: should not happen
@@ -712,9 +851,10 @@ class DeviceTable(Table):
                 hot_bin_cap = min(local_cap, hot_bin_cap * 2)
             # device-measured payload: live rows that left their home
             be.ici_payload_bytes += (
-                row_bytes(l_arrs) * be.consume_count(sent_l)
-                + row_bytes(r_arrs) * be.consume_count(sent_r))
-            total_dev = be.consume_count(max_left if left_join else max_total)
+                row_bytes(l_arrs) * be.consume_count(sent_l, relation="stat")
+                + row_bytes(r_arrs) * be.consume_count(sent_r, relation="stat"))
+            total_dev = be.consume_count(max_left if left_join else max_total,
+                                         relation="cap")
             out_cap_dev = be.bucket(max(1, total_dev))
             prog2 = DJ.make_radix_join_phase2(be.mesh, axis, n_l, n_r,
                                               out_cap_dev, left_join)
@@ -743,7 +883,11 @@ class DeviceTable(Table):
     def _cross_join(self, other: "DeviceTable") -> "DeviceTable":
         total = self._n * other._n
         out_cap = self.backend.bucket(total)
-        counts = jnp.where(self.row_ok, other._n, 0)
+        # per-live-left-row pair count: the exact device count when the
+        # right side rides generic replay (other._n is then only a
+        # served upper bound), the host int otherwise
+        count_b = other._live if other._live is not None else other._n
+        counts = jnp.where(self.row_ok, count_b, 0)
         offsets = jnp.cumsum(counts)
         t = jnp.arange(out_cap)
         l_idx = jnp.clip(jnp.searchsorted(offsets, t, side="right"),
@@ -752,7 +896,10 @@ class DeviceTable(Table):
         within = (t - seg_start) % max(1, other.capacity)
         out_cols = _gather_cols(self._cols, l_idx)
         out_cols.update(_gather_cols(other._cols, within))
-        return DeviceTable(self.backend, out_cols, total)
+        live = (offsets[-1].astype(jnp.int32)
+                if (self._live is not None or other._live is not None)
+                and self.capacity > 0 else None)
+        return DeviceTable(self.backend, out_cols, total, live=live)
 
     def union_all(self, other: Table) -> "DeviceTable":
         if self._local is not None or (isinstance(other, DeviceTable)
@@ -778,7 +925,20 @@ class DeviceTable(Table):
                         f"union kind mismatch {a.kind}/{b.kind}").union_all(other)
             out[c] = _concat_columns(a, self._n, b, other._n, out_cap,
                                      a.ctype.join(b.ctype))
-        return DeviceTable(self.backend, out, total)
+        if self._live is None and other._live is None:
+            return DeviceTable(self.backend, out, total)
+        # generic replay: either side's live prefix may be shorter than
+        # its served n, leaving a dead gap in the middle of the concat —
+        # close it with a sync-free same-capacity compaction
+        live_a = (self._live if self._live is not None
+                  else jnp.int32(self._n))
+        live_b = (other._live if other._live is not None
+                  else jnp.int32(other._n))
+        t = jnp.arange(out_cap)
+        mask = (t < live_a) | ((t >= self._n) & (t < self._n + live_b))
+        idx, _ = K.compact_indices(mask, out_cap)
+        return DeviceTable(self.backend, _gather_cols(out, idx), total,
+                           live=(live_a + live_b).astype(jnp.int32))
 
     def _sort_perm(self, keys: List[jnp.ndarray]) -> jnp.ndarray:
         """Stable multi-key sort permutation: the Pallas bitonic kernel
@@ -808,8 +968,12 @@ class DeviceTable(Table):
             return self._fallback(str(ex)).distinct()
         sorted_cols = _gather_cols(self._cols, perm)
         change = K.neighbor_change_keys([k[perm] for k in keys])
-        keep = change & K.row_mask(self.capacity, self._n)
-        tmp = DeviceTable(self.backend, sorted_cols, self._n)
+        # the sort puts dead rows last, so the sorted live mask is the
+        # row_ok PREFIX (includes the generic-replay live count, which a
+        # plain host row_mask would not)
+        keep = change & self.row_ok[perm]
+        tmp = DeviceTable(self.backend, sorted_cols, self._n,
+                          live=self._live)
         return tmp._compact(keep)
 
     def order_by(self, items: Sequence[Tuple[str, bool]]) -> "DeviceTable":
@@ -825,7 +989,7 @@ class DeviceTable(Table):
         except UnsupportedOnDevice as ex:
             return self._fallback(str(ex)).order_by(items)
         return DeviceTable(self.backend, _gather_cols(self._cols, perm),
-                           self._n)
+                           self._n, live=self._live)
 
     def skip(self, n: int) -> "DeviceTable":
         if self._local is not None:
@@ -835,7 +999,10 @@ class DeviceTable(Table):
         out_cap = self.backend.bucket(new_n)
         idx = jnp.arange(out_cap) + n
         idx = jnp.clip(idx, 0, max(0, self.capacity - 1))
-        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+        live = (jnp.maximum(self._live - n, 0).astype(jnp.int32)
+                if self._live is not None else None)
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx),
+                           new_n, live=live)
 
     def limit(self, n: int) -> "DeviceTable":
         if self._local is not None:
@@ -843,7 +1010,10 @@ class DeviceTable(Table):
         new_n = min(max(0, n), self._n)
         out_cap = self.backend.bucket(new_n)
         idx = jnp.clip(jnp.arange(out_cap), 0, max(0, self.capacity - 1))
-        return DeviceTable(self.backend, _gather_cols(self._cols, idx), new_n)
+        live = (jnp.minimum(self._live, n).astype(jnp.int32)
+                if self._live is not None else None)
+        return DeviceTable(self.backend, _gather_cols(self._cols, idx),
+                           new_n, live=live)
 
     # -- aggregation ------------------------------------------------------
 
@@ -886,18 +1056,20 @@ class DeviceTable(Table):
                 keys.extend(_sort_keys(self._cols[c], True, True, pool))
             perm = self._sort_perm(keys)
             sorted_cols = _gather_cols(self._cols, perm)
+            row_ok_sorted = self.row_ok[perm]
             change = K.neighbor_change_keys(
-                [k[perm] for k in keys[1:]]) & K.row_mask(cap, self._n)
+                [k[perm] for k in keys[1:]]) & row_ok_sorted
             seg_id = jnp.clip(jnp.cumsum(change.astype(jnp.int32)) - 1, 0, None)
-            n_groups = self.backend.consume_count(K.mask_count(change))
+            n_groups, groups_live = self.backend.consume_rows(
+                K.mask_count(change))
         else:
             sorted_cols = dict(self._cols)
             seg_id = jnp.zeros(cap, jnp.int32)
-            n_groups = 1
+            n_groups, groups_live = 1, None
             change = jnp.zeros(cap, bool).at[0].set(True) \
                 if cap > 0 else jnp.zeros(cap, bool)
+            row_ok_sorted = self.row_ok
         out_cap = self.backend.bucket(n_groups)
-        row_ok_sorted = K.row_mask(cap, self._n)
         if by:
             start_idx, _ = K.compact_indices(change, out_cap)
         else:
@@ -941,7 +1113,7 @@ class DeviceTable(Table):
             out[a.name] = self._one_agg(a, sorted_cols, seg_id, num_segments,
                                         row_ok_sorted, n_groups,
                                         firstocc=extra, start_idx=start_idx)
-        return DeviceTable(self.backend, out, n_groups)
+        return DeviceTable(self.backend, out, n_groups, live=groups_live)
 
     def _percentile_agg(self, a: AggSpec, cols: Dict[str, Column],
                         group_keys_sorted, seg_id, num_segments: int,
@@ -1032,8 +1204,10 @@ class DeviceTable(Table):
             col = self._cols[c]
             if col.kind == "int":
                 ok = col.valid & row_ok
-                lo = self.backend.consume_count(jnp.min(jnp.where(ok, col.data, 0)))
-                hi = self.backend.consume_count(jnp.max(jnp.where(ok, col.data, 0)))
+                lo = self.backend.consume_count(
+                    jnp.min(jnp.where(ok, col.data, 0)), relation="lo")
+                hi = self.backend.consume_count(
+                    jnp.max(jnp.where(ok, col.data, 0)), relation="cap")
                 if not (-2**31 < lo and hi < 2**31):
                     return None
 
@@ -1175,14 +1349,15 @@ class DeviceTable(Table):
             raise UnsupportedOnDevice("collect to host-only list type")
         if col.kind == "int":
             lo = self.backend.consume_count(
-                jnp.min(jnp.where(ok, col.data, 0)))
+                jnp.min(jnp.where(ok, col.data, 0)), relation="lo")
             hi = self.backend.consume_count(
-                jnp.max(jnp.where(ok, col.data, 0)))
+                jnp.max(jnp.where(ok, col.data, 0)), relation="cap")
             if not (-2**31 < lo and hi < 2**31):
                 raise UnsupportedOnDevice("collect of int64-range values")
         counts = K.segment_agg(col.data, ok, seg_id, num_segments, "count")
         max_len = self.backend.consume_count(
-            jnp.max(counts) if num_segments else jnp.int64(0))
+            jnp.max(counts) if num_segments else jnp.int64(0),
+            relation="cap")
         L = max(1, int(max_len))
         # rank of each kept row within its segment
         c = jnp.cumsum(ok.astype(jnp.int32))
@@ -1210,7 +1385,8 @@ class DeviceTable(Table):
             return self._fallback("explode of non-list column").explode(
                 list_col, out_col, out_type)
         ok = col.valid & self.row_ok
-        total = self.backend.consume_count(jnp.where(ok, col.lens, 0).sum())
+        total, live = self.backend.consume_rows(
+            jnp.where(ok, col.lens, 0).sum())
         out_cap = self.backend.bucket(total)
         row, within, out_valid, _ = K.explode_expand(col.lens, ok, out_cap)
         rest = {c: v for c, v in self._cols.items() if c != list_col}
@@ -1226,7 +1402,7 @@ class DeviceTable(Table):
         else:
             values = values.astype(_DTYPES[out_kind])
         out_cols[out_col] = Column(out_kind, values, out_valid, out_type)
-        return DeviceTable(self.backend, out_cols, total)
+        return DeviceTable(self.backend, out_cols, total, live=live)
 
     def pack_list(self, cols: Sequence[str], out_col: str,
                   out_type: CypherType) -> "DeviceTable":
@@ -1256,14 +1432,15 @@ class DeviceTable(Table):
         out = dict(self._cols)
         out[out_col] = Column("list", data, jnp.ones(cap, bool), out_type,
                               lens)
-        return DeviceTable(self.backend, out, self._n)
+        return self._with_cols(out)
 
     # -- materialization --------------------------------------------------
 
     def column_values(self, col: str) -> List[Any]:
         if self._local is not None:
             return self._local.column_values(col)
-        return column_to_host(self._cols[col], self._n, self.backend.pool)
+        return column_to_host(self._cols[col], self._exact_n(),
+                              self.backend.pool)
 
     def host_column(self, col: str):
         """(values, ok) numpy host view of an integer column — the
@@ -1277,17 +1454,25 @@ class DeviceTable(Table):
         if c is None or c.kind not in ("id", "int"):
             return None
         d, v = c.host_arrays()
-        return d, v & (np.arange(c.capacity) < self._n)
+        # _exact_n, not _n: under generic replay the served bound covers
+        # dead-gap rows whose gathered values LOOK valid — a host plan
+        # builder (ring var-expand seeds) must never see them.  The sync
+        # this costs is already a host materialization site.
+        return d, v & (np.arange(c.capacity) < self._exact_n())
 
     def device_column(self, col: str):
         """(data, valid, live_row_count) without host materialization —
         the async result surface: callers can keep results on device and
         batch their transfers (each device→host read is a full transport
-        round trip)."""
+        round trip).  live_row_count is a host int in eager mode but a
+        DEVICE scalar for a table produced under generic fused replay
+        (where the host only knows an upper bound) — callers must treat
+        it as array-like and fold it into their batched transfer."""
         if self._local is not None:
             raise UnsupportedOnDevice("table is in host-fallback mode")
         c = self._cols[col]
-        return c.data, c.valid, self._n
+        return c.data, c.valid, (self._live if self._live is not None
+                                 else self._n)
 
 
 @jax.jit

@@ -127,7 +127,11 @@ _MARKER = "PALLAS_PROBE_OK"
 # non-conclusive subprocess failure leaves the family unknown=False for
 # this process (twins, no disk write); a healthy later process re-probes.
 _PALLAS_ERR_MARKERS = ("pallas", "mosaic", "RecursionError",
-                       "remote_compile", "tpu_compile")
+                       "remote_compile", "tpu_compile",
+                       # real-kernel probes compare against the jnp twin;
+                       # a numerical mismatch is a CONCLUSIVE wrong-results
+                       # verdict that must reach the disk cache
+                       "Mismatched elements", "Arrays are not")
 
 
 def _cache_path() -> str:

@@ -77,7 +77,9 @@ class ConstructOp(R.RelationalOperator):
         from caps_tpu.relational.graphs import UnionGraph
         parent = self.children[0]
         header, table = parent.result
-        n = table.size
+        # exact: CONSTRUCT mints entity ids per input row — a served
+        # upper bound (generic fused replay) would mint phantom entities
+        n = table.exact_size()
         params = self.context.parameters
 
         set_vars = {s.var for s in self.sets}

@@ -989,8 +989,8 @@ class CountPatternOp(RelationalOperator):
             if vals.shape[0]:
                 mx = jnp.maximum(mx, jnp.max(jnp.where(
                     ok, vals.astype(jnp.int64), -1)))
-        n = (backend.consume_count(mx) if backend is not None
-             else int(mx)) + 1
+        n = (backend.consume_count(mx, relation="cap")
+             if backend is not None else int(mx)) + 1
         if n <= 0:
             n = 1
         if n > _MAX_DOMAIN:

@@ -701,7 +701,7 @@ class OptionalJoinOp(RelationalOperator):
                 [(e, lh.column(e), lh.type_of(e)) for e in lh.exprs
                  if e != E.Var(self.rid_col)] + new_entries)
             new_cols = [c for _, c, _ in new_entries if c not in lhs_cols]
-            if rt.size == 0:
+            if rt.branch_empty():
                 out = lt
                 for e, c, t in new_entries:
                     if c not in lhs_cols:

@@ -77,13 +77,13 @@ class RelationalCypherRecords(CypherRecords):
         return self._table
 
     def size(self) -> int:
-        return self._table.size
+        return self._table.exact_size()
 
     # -- materialization ----------------------------------------------------
 
     def to_maps(self) -> List[Dict[str, Any]]:
         header, table = self._header, self._table
-        n = table.size
+        n = table.exact_size()
         out: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name in self._columns:
             values = self._materialize_var(name, header, table, n)
@@ -348,7 +348,9 @@ class RelationalCypherSession(CypherSession):
         metrics = {
             "parse_s": t1 - t0, "ir_s": t2 - t1, "plan_s": t3 - t2,
             "relational_s": t4 - t3, "execute_s": t5 - t4,
-            "rows": records.size() if records is not None else 0,
+            # size_hint: never syncs (generic replay may only know an
+            # upper bound until the result is materialized)
+            "rows": records.table.size_hint() if records is not None else 0,
             "operators": context.op_metrics,
             # roofline numerator: bytes the operators pulled through
             # memory; achieved GB/s = bytes_touched / execute_s

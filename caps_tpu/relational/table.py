@@ -56,6 +56,27 @@ class Table(abc.ABC):
     def size(self) -> int:
         ...
 
+    def exact_size(self) -> int:
+        """The exact live row count.  Equal to ``size`` everywhere except
+        a device table under generic fused replay, where ``size`` is a
+        served upper bound and this method pays the one materialization
+        sync.  Use at materialization boundaries only."""
+        return self.size
+
+    def size_hint(self) -> int:
+        """A row count that NEVER syncs: exact when known (eager mode, or
+        after a materialization already paid the sync), otherwise the
+        served upper bound.  For metrics/logging only."""
+        return self.size
+
+    def branch_empty(self) -> bool:
+        """``size == 0`` as a CONTROL-FLOW predicate.  Plan code must use
+        this (not ``.size``) when branching on emptiness: under generic
+        fused replay ``size`` is a served upper bound, and this method
+        routes the decision through the record/replay stream so a
+        divergent branch is detected instead of silently followed."""
+        return self.size == 0
+
     @property
     def nbytes(self) -> int:
         """Approximate resident bytes of this table's columns — the input

@@ -339,7 +339,7 @@ class VarExpandOp(RelationalOperator):
             for tag, lens in length_runs:
                 m = run_chunk(f0, lens)
                 counts = m.reshape(-1)
-                total = backend.consume_count(counts.sum())
+                total, live = backend.consume_rows(counts.sum())
                 out_cap = backend.bucket(total)
                 row, _within, valid, _tot = K.explode_expand(
                     counts, jnp.ones_like(counts, dtype=bool), out_cap)
@@ -362,7 +362,7 @@ class VarExpandOp(RelationalOperator):
                         backend.place_rows(jnp.full(out_cap, tag,
                                                     jnp.int64)),
                         backend.place_rows(valid), CTInteger)
-                parts.append(DeviceTable(backend, cols, n=total))
+                parts.append(DeviceTable(backend, cols, n=total, live=live))
         # balanced pairwise concat: incremental union over many chunk x
         # length parts would re-copy the accumulated rows quadratically
         while len(parts) > 1:

@@ -46,7 +46,11 @@ def test_replay_is_sync_free_and_correct():
     assert session.backend.syncs == syncs_after_record
 
 
-def test_distinct_params_get_distinct_recordings():
+def test_distinct_params_never_stale_hit():
+    """Distinct parameter values must NEVER serve each other's exact
+    sizes as truth.  (They used to force a second recording; with
+    param-generic replay the second value may instead ride the merged
+    stream — the observable contract is exact per-param results.)"""
     session = TPUCypherSession()
     g = _social(session)
     q = ("MATCH (a:Person)-[:KNOWS]->(b) WHERE a.name = $seed "
@@ -54,11 +58,13 @@ def test_distinct_params_get_distinct_recordings():
     c_alice = g.cypher(q, {"seed": "Alice"}).records.to_maps()[0]["c"]
     c_bob = g.cypher(q, {"seed": "Bob"}).records.to_maps()[0]["c"]
     assert (c_alice, c_bob) == (1, 2)
-    assert session.fused.recordings == 2
-    # replays with the matching key serve the right sizes
+    # the second value rode EITHER a fresh recording (violation path) or
+    # a generic replay of the merged stream — never a stale exact hit
+    assert session.fused.recordings + session.fused.generic_replays >= 2
+    # repeats serve the right per-param results from either memo level
     assert g.cypher(q, {"seed": "Bob"}).records.to_maps()[0]["c"] == 2
     assert g.cypher(q, {"seed": "Alice"}).records.to_maps()[0]["c"] == 1
-    assert session.fused.replays == 2
+    assert session.fused.replays + session.fused.generic_replays >= 3
 
 
 def test_mismatch_recovery_rerecords():
