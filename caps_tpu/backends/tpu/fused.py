@@ -75,14 +75,19 @@ def _params_key(params: Mapping[str, Any]) -> Optional[str]:
         return None  # unorderable/unhashable params: skip fusion
 
 
-def _merge_streams(merged: List[Tuple], rec: List[Tuple]
-                   ) -> Optional[List[Tuple]]:
+def _merge_streams(merged: List[Tuple], rec: List[Tuple],
+                   widen_rows=None) -> Optional[List[Tuple]]:
     """Merge a fresh recording into the param-generic stream: entry
     tags must align 1:1 (the op sequence must not depend on params);
     capacity-like values widen to the max, lower bounds to the min,
     exact values must agree, stats/objects take the latest.  Returns
     None when the streams are structurally incompatible (the query is
-    then not param-generic)."""
+    then not param-generic).
+
+    ``widen_rows`` (the backend's bucket function) adds convergence
+    headroom: a row cap that a new recording EXCEEDED jumps to its
+    bucket boundary, so per-param size jitter stops re-recording once
+    the stream has seen the workload's bucket."""
     if len(merged) != len(rec):
         return None
     out: List[Tuple] = []
@@ -92,7 +97,10 @@ def _merge_streams(merged: List[Tuple], rec: List[Tuple]
         if m[0] == "__obj__":
             out.append(r)
         elif m[0] == "rows":
-            out.append(("rows", max(m[1], r[1])))
+            hi = max(m[1], r[1])
+            if widen_rows is not None and r[1] > m[1]:
+                hi = max(hi, widen_rows(r[1]))
+            out.append(("rows", hi))
         else:  # ("size", value, relation)
             if m[2] != r[2]:
                 return None
@@ -247,6 +255,7 @@ class FusedExecutor:
                         "generic replay relation violated (an actual "
                         "size exceeded its served bound) — re-recording")
             self.generic_replays += 1
+            generic[2] = 0  # only CONSECUTIVE violations disable the key
             return
         state["mode"] = "record"
         rec: List[Tuple] = []
@@ -270,6 +279,6 @@ class FusedExecutor:
             # first recording at this pool size seeds the generic stream
             self._generic[gkey] = [pool_n, list(rec), 0]
         elif g[1] is not None:
-            g[1] = _merge_streams(g[1], rec)  # None → not param-generic
+            g[1] = _merge_streams(g[1], rec, widen_rows=backend.bucket)
         while len(self._generic) > max(1, self.max_entries):
             self._generic.pop(next(iter(self._generic)))

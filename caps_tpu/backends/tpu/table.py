@@ -232,6 +232,11 @@ class DeviceBackend:
             return v, None
         v = self._next_entry(mode, "rows")
         if mode[0] == "replay_gen":
+            # strict: actual must fit the SERVED count, not just its
+            # bucket — consumers like union's concat offset slice by the
+            # served n, so bucket slack is not uniformly safe.  Headroom
+            # comes from the merge widening violated row caps to the
+            # next bucket boundary instead (fused._merge_streams).
             self._accumulate_violation(dev_scalar, v[1], "cap")
             return v[1], jnp.asarray(dev_scalar).astype(jnp.int32)
         return v[1], None

@@ -105,10 +105,16 @@ def test_violation_rerecords_exactly(graphs):
     # sizes exceed every recorded cap — the flag must fire and re-record
     res_hi = g.cypher(q, {"x": 65})
     want_lo = og.cypher(q, {"x": 0}).records.to_maps()
-    before = sess._impl.fused.mismatches if hasattr(sess, "_impl") else None
+    mismatches_before = sess.fused.mismatches
+    recordings_before = sess.fused.recordings
     res_lo = g.cypher(q, {"x": 0})
     assert res_lo.records.to_maps() == want_lo
     assert len(want_lo) > len(res_hi.records.to_maps())
+    # the low-threshold run must NOT have ridden the stale generic
+    # stream to completion: either the violation flag fired (mismatch +
+    # re-record) or the run recorded outright
+    assert (sess.fused.mismatches > mismatches_before
+            or sess.fused.recordings > recordings_before)
 
 
 def test_exact_replay_still_zero_syncs(graphs):
@@ -156,3 +162,10 @@ def test_merge_streams_rules():
         is None
     assert _merge_streams([("rows", 1)], [("size", 1, "cap")]) is None
     assert _merge_streams([("rows", 1)], []) is None
+    # a row cap the new recording EXCEEDED widens to its bucket boundary
+    # (convergence headroom); one that still fits does not
+    widen = lambda n: 1 << max(0, (n - 1)).bit_length()
+    assert _merge_streams([("rows", 5)], [("rows", 9)],
+                          widen_rows=widen) == [("rows", 16)]
+    assert _merge_streams([("rows", 16)], [("rows", 9)],
+                          widen_rows=widen) == [("rows", 16)]
