@@ -261,7 +261,16 @@ class VarExpandOp(RelationalOperator):
         per_seed = max(n_pad, edges_per_device)
         if per_seed > self._RING_MAX_MATRIX:
             return None  # even one seed's per-hop gather exceeds budget
-        chunk = max(1, min(n_seeds, self._RING_MAX_MATRIX // per_seed))
+        # pow2-pad the chunk dimension: tying it to the exact seed count
+        # would recompile the hop programs (and rebuild different
+        # shapes) for every distinct parameter value — padded chunks
+        # keep shapes stable across a parameter sweep, and the last
+        # block is zero-padded anyway.  Plain pow2, NOT backend.bucket:
+        # its 256-row minimum would inflate a single-seed frontier (the
+        # common point-lookup expand) by 256x in host upload and hop
+        # gather work.
+        seeds_p2 = 1 << max(0, n_seeds - 1).bit_length()
+        chunk = max(1, min(seeds_p2, self._RING_MAX_MATRIX // per_seed))
         n_chunks = (n_seeds + chunk - 1) // chunk
         if n_chunks > 64:  # degenerate shapes stay on the join path
             return None
