@@ -613,6 +613,12 @@ def run_ldbc_bench(scale: float = 11.0, on_tpu: bool = True,
             "unit": "s p50",
             "vs_baseline": 0.0,
             "build_s": round(build_s, 1),
+            # suite-level audit rollups (per-query detail in "queries")
+            "fallbacks_total": sum(v.get("fallbacks", 0)
+                                   for v in per_query.values()),
+            "steady_syncs_max": max(
+                (v["steady_syncs"] for v in per_query.values()
+                 if v.get("steady_syncs") is not None), default=None),
             "queries": dict(per_query),
         }
         if result_sink is not None:
