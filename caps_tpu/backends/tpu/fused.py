@@ -33,7 +33,8 @@ import contextlib
 import itertools
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
-from caps_tpu.backends.tpu.table import DeviceBackend, FusedReplayMismatch
+from caps_tpu.backends.tpu.table import (DeviceBackend, DeviceTable,
+                                          FusedReplayMismatch)
 
 _graph_epochs = itertools.count()
 
@@ -179,10 +180,15 @@ class FusedExecutor:
         return g
 
     def run(self, key: Optional[Tuple], thunk: Callable[[], Any]) -> Any:
-        state: Dict[str, Optional[str]] = {"mode": None}
+        state: Dict[str, Any] = {"mode": None}
         try:
             with self._activate(key, state):
-                return thunk()
+                result = thunk()
+                # expose the result to the generic-replay epilogue so the
+                # violation-flag sync can batch with the result table's
+                # exact-count read (one transfer instead of two)
+                state["result"] = result
+                return result
         except Exception:
             if state["mode"] not in ("replay", "replay_gen"):
                 # ambient/record-mode failures are genuine errors; a retry
@@ -204,7 +210,7 @@ class FusedExecutor:
 
     @contextlib.contextmanager
     def _activate(self, key: Optional[Tuple],
-                  state: Optional[Dict[str, Optional[str]]] = None,
+                  state: Optional[Dict[str, Any]] = None,
                   force_record: bool = False):
         if state is None:
             state = {"mode": None}
@@ -250,7 +256,15 @@ class FusedExecutor:
             backend._replay_viol = None
             if viol is not None:
                 backend.syncs += 1  # the one end-of-query check
-                if bool(viol):
+                # Batch the flag read with the result table's exact row
+                # count (DeviceTable.prime_exact): steady state then
+                # pays exactly ONE round trip per query — a later
+                # to_maps reads the pre-paid exact-count cache.
+                table = getattr(getattr(state.get("result"), "records",
+                                        None), "table", None)
+                bad = (table.prime_exact(viol)
+                       if isinstance(table, DeviceTable) else bool(viol))
+                if bad:
                     raise FusedReplayMismatch(
                         "generic replay relation violated (an actual "
                         "size exceeded its served bound) — re-recording")

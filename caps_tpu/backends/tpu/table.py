@@ -401,6 +401,22 @@ class DeviceTable(Table):
             lambda: (self._live if self._live is not None
                      else jnp.int32(self._n)) == 0)
 
+    def prime_exact(self, viol) -> bool:
+        """Read the generic-replay violation flag batched with this
+        table's exact live count in ONE transfer; primes the exact-count
+        cache when the flag is clear (so a later ``to_maps`` pays no
+        second round trip).  Returns the flag's truth value.  Falls back
+        to a plain flag read when there is nothing to batch."""
+        if self._live is None or self._exact_cache is not None:
+            return bool(viol)
+        both = np.asarray(jnp.stack(
+            [jnp.asarray(viol).astype(jnp.int32),
+             jnp.asarray(self._live).astype(jnp.int32)]))
+        bad = bool(both[0])
+        if not bad:
+            self._exact_cache = int(both[1])
+        return bad
+
     # -- shape ----------------------------------------------------------
 
     @property

@@ -158,3 +158,36 @@ Feature: Aggregation
       | g   | s |
       | 'a' | 3 |
       | 'b' | 9 |
+
+  Scenario: aggregation with zero groups returns a single row for the global aggregate
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n:Missing) RETURN count(n) AS c, sum(n.v) AS s
+      """
+    Then the result should be, in any order:
+      | c | s |
+      | 0 | 0 |
+
+  Scenario: grouped aggregation over an empty match returns no rows
+    Given an empty graph
+    When executing query:
+      """
+      MATCH (n:Missing) RETURN n.v AS v, count(*) AS c
+      """
+    Then the result should be, in any order:
+      | v | c |
+
+  Scenario: avg over a mix of ints and floats
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2.0}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN avg(n.v) AS a
+      """
+    Then the result should be, in any order:
+      | a   |
+      | 2.0 |

@@ -123,3 +123,35 @@ Feature: CASE expressions
       | 'c' |
       | 'a' |
       | 'b' |
+
+  Scenario: searched CASE falls through to ELSE on null input
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 10}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N)
+      RETURN CASE WHEN n.v < 5 THEN 'small' WHEN n.v >= 5 THEN 'big' ELSE 'none' END AS bucket
+      """
+    Then the result should be, in any order:
+      | bucket  |
+      | 'small' |
+      | 'big'   |
+      | 'none'  |
+
+  Scenario: simple CASE with no ELSE yields null when nothing matches
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 7})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN CASE n.v WHEN 1 THEN 'one' END AS w
+      """
+    Then the result should be, in any order:
+      | w     |
+      | 'one' |
+      | null  |

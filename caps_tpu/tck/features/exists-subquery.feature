@@ -126,3 +126,48 @@ Feature: Exists subqueries
     Then the result should be, in any order:
       | n   |
       | 'a' |
+
+  Scenario: NOT EXISTS keeps rows whose pattern has no match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (x:X), (a)-[:T]->(x)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE NOT EXISTS { (p)-[:T]->(:X) } RETURN p.n AS p
+      """
+    Then the result should be, in any order:
+      | p   |
+      | 'b' |
+
+  Scenario: EXISTS with a WHERE clause inside the subquery
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}),
+             (x:X {v: 1}), (y:X {v: 9}),
+             (a)-[:T]->(x), (b)-[:T]->(y)
+      """
+    When executing query:
+      """
+      MATCH (p:P) WHERE EXISTS { MATCH (p)-[:T]->(q:X) WHERE q.v > 5 } RETURN p.n AS p
+      """
+    Then the result should be, in any order:
+      | p   |
+      | 'b' |
+
+  Scenario: EXISTS in RETURN projects a boolean per row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (x:X), (a)-[:T]->(x)
+      """
+    When executing query:
+      """
+      MATCH (p:P) RETURN p.n AS p, EXISTS { (p)-[:T]->(:X) } AS has
+      """
+    Then the result should be, in any order:
+      | p   | has   |
+      | 'a' | true  |
+      | 'b' | false |

@@ -95,3 +95,92 @@ Feature: Null semantics
     Then the result should be, in any order:
       | v    |
       | null |
+
+  Scenario: null propagates through arithmetic
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v + 1 AS plus, n.v AS raw
+      """
+    Then the result should be, in any order:
+      | plus | raw  |
+      | 2    | 1    |
+      | 3    | 2    |
+      | null | null |
+
+  Scenario: IN with a null element is null when no match is found
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 3})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v, n.v IN [1, null] AS found
+      """
+    Then the result should be, in any order:
+      | v | found |
+      | 1 | true  |
+      | 3 | null  |
+
+  Scenario: comparison with null is null and filters the row out
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) WHERE n.v > 0 RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+
+  Scenario: coalesce returns the first non-null argument per row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {a: 1}), (:N {b: 2}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN coalesce(n.a, n.b, -1) AS c
+      """
+    Then the result should be, in any order:
+      | c  |
+      | 1  |
+      | 2  |
+      | -1 |
+
+  Scenario: min and max ignore nulls and are null over only-null input
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 5}), (:N), (:M)
+      """
+    When executing query:
+      """
+      MATCH (n:N) OPTIONAL MATCH (m:Missing) RETURN min(n.v) AS lo, max(m) AS hi
+      """
+    Then the result should be, in any order:
+      | lo | hi   |
+      | 5  | null |
+
+  Scenario: count of an expression skips nulls while count star does not
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N {v: 2}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN count(n.v) AS cv, count(*) AS cs
+      """
+    Then the result should be, in any order:
+      | cv | cs |
+      | 2  | 3  |

@@ -56,3 +56,69 @@ Feature: Optional match
     Then the result should be, in any order:
       | p      | qn   | missing |
       | 'solo' | null | true    |
+
+  Scenario: uncorrelated OPTIONAL MATCH pairs every lhs row with every match
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'}), (:P {n: 'b'}), (:Q {v: 1}), (:Q {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (q:Q) RETURN p.n AS p, q.v AS v
+      """
+    Then the result should be, in any order:
+      | p   | v |
+      | 'a' | 1 |
+      | 'a' | 2 |
+      | 'b' | 1 |
+      | 'b' | 2 |
+
+  Scenario: uncorrelated OPTIONAL MATCH over an empty pattern null-pads every lhs row
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:P {n: 'a'}), (:P {n: 'b'})
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (q:Missing) RETURN p.n AS p, q AS q
+      """
+    Then the result should be, in any order:
+      | p   | q    |
+      | 'a' | null |
+      | 'b' | null |
+
+  Scenario: chained OPTIONAL MATCHes keep earlier nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (c:C {v: 7}), (a)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (p:P)
+      OPTIONAL MATCH (p)-[:T]->(c:C)
+      OPTIONAL MATCH (c)-[:U]->(d)
+      RETURN p.n AS p, c.v AS c, d AS d
+      """
+    Then the result should be, in any order:
+      | p   | c    | d    |
+      | 'a' | 7    | null |
+      | 'b' | null | null |
+
+  Scenario: aggregation over an OPTIONAL MATCH counts null matches as zero
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:P {n: 'a'}), (b:P {n: 'b'}), (x:X), (a)-[:T]->(x)
+      """
+    When executing query:
+      """
+      MATCH (p:P) OPTIONAL MATCH (p)-[:T]->(x:X)
+      RETURN p.n AS p, count(x) AS c
+      """
+    Then the result should be, in any order:
+      | p   | c |
+      | 'a' | 1 |
+      | 'b' | 0 |

@@ -140,3 +140,50 @@ Feature: Return and order
       | y  |
       | 20 |
       | 30 |
+
+  Scenario: ORDER BY a column not in the projection
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {n: 'b', v: 2}), (:N {n: 'a', v: 3}), (:N {n: 'c', v: 1})
+      """
+    When executing query:
+      """
+      MATCH (x:N) RETURN x.n AS n ORDER BY x.v
+      """
+    Then the result should be, in order:
+      | n   |
+      | 'c' |
+      | 'b' |
+      | 'a' |
+
+  Scenario: ORDER BY with SKIP and LIMIT windows the sorted rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 4}), (:N {v: 1}), (:N {v: 3}), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (x:N) RETURN x.v AS v ORDER BY v SKIP 1 LIMIT 2
+      """
+    Then the result should be, in order:
+      | v |
+      | 2 |
+      | 3 |
+
+  Scenario: DESC ordering puts nulls first
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N), (:N {v: 2})
+      """
+    When executing query:
+      """
+      MATCH (x:N) RETURN x.v AS v ORDER BY v DESC
+      """
+    Then the result should be, in order:
+      | v    |
+      | null |
+      | 2    |
+      | 1    |

@@ -87,3 +87,72 @@ Feature: Unwind and union
       MATCH (a) RETURN a UNION MATCH (b) RETURN b
       """
     Then a SyntaxError should be raised at compile time: DifferentColumnsInUnion
+
+  Scenario: UNWIND of an empty list produces no rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) UNWIND [] AS x RETURN n.v AS v, x AS x
+      """
+    Then the result should be, in any order:
+      | v | x |
+
+  Scenario: UNWIND of null produces no rows
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) UNWIND n.missing AS x RETURN x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+
+  Scenario: nested UNWIND forms the cross product of the lists
+    Given an empty graph
+    When executing query:
+      """
+      UNWIND [1, 2] AS a UNWIND ['x', 'y'] AS b RETURN a, b
+      """
+    Then the result should be, in any order:
+      | a | b   |
+      | 1 | 'x' |
+      | 1 | 'y' |
+      | 2 | 'x' |
+      | 2 | 'y' |
+
+  Scenario: UNION deduplicates rows containing nulls
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1}), (:N)
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v UNION MATCH (n:N) RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v    |
+      | 1    |
+      | null |
+
+  Scenario: UNION ALL keeps duplicates from both branches
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (:N {v: 1})
+      """
+    When executing query:
+      """
+      MATCH (n:N) RETURN n.v AS v UNION ALL MATCH (n:N) RETURN n.v AS v
+      """
+    Then the result should be, in any order:
+      | v |
+      | 1 |
+      | 1 |

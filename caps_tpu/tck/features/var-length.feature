@@ -115,3 +115,63 @@ Feature: Var-length expand
       | n   |
       | 'b' |
       | 'c' |
+
+  Scenario: zero-length var expansion binds the start node itself
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N {n: 'a'}), (b:N {n: 'b'}), (a)-[:T]->(b)
+      """
+    When executing query:
+      """
+      MATCH (a:N {n: 'a'})-[:T*0..1]->(x) RETURN x.n AS x
+      """
+    Then the result should be, in any order:
+      | x   |
+      | 'a' |
+      | 'b' |
+
+  Scenario: var-length lower bound above the longest path matches nothing
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N), (b:N), (a)-[:T]->(b)
+      """
+    When executing query:
+      """
+      MATCH (a:N)-[:T*3..4]->(x) RETURN x AS x
+      """
+    Then the result should be, in any order:
+      | x |
+
+  Scenario: undirected var-length reaches both directions
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N {n: 'a'}), (b:N {n: 'b'}), (c:N {n: 'c'}),
+             (a)-[:T]->(b), (c)-[:T]->(b)
+      """
+    When executing query:
+      """
+      MATCH (s:N {n: 'a'})-[:T*1..2]-(x) RETURN DISTINCT x.n AS x
+      """
+    Then the result should be, in any order:
+      | x   |
+      | 'b' |
+      | 'c' |
+
+  Scenario: var-length relationship list has one entry per hop
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:N {n: 'a'}), (b:N {n: 'b'}), (c:N {n: 'c'}),
+             (a)-[:T]->(b), (b)-[:T]->(c)
+      """
+    When executing query:
+      """
+      MATCH (a:N {n: 'a'})-[rs:T*1..2]->(x) RETURN x.n AS x, size(rs) AS hops
+      """
+    Then the result should be, in any order:
+      | x   | hops |
+      | 'b' | 1    |
+      | 'c' | 2    |

@@ -91,10 +91,11 @@ def test_steady_state_sync_collapse(graphs):
         want = og.cypher(q, {"x": lim}).records.to_maps()
         assert res.records.to_maps() == want
         syncs.append(res.metrics["size_syncs"])
-    # first run records (several syncs); the tail must collapse to the
-    # single end-of-query flag check + at most one materialization sync
+    # first run records (several syncs); the tail must collapse to ONE
+    # round trip (the violation-flag read batches the result table's
+    # exact row count)
     assert syncs[0] >= 2
-    assert max(syncs[-3:]) <= 2, syncs
+    assert max(syncs[-3:]) <= 1, syncs
 
 
 def test_violation_rerecords_exactly(graphs):
