@@ -96,6 +96,11 @@ def _merge_streams(merged: List[Tuple], rec: List[Tuple],
         if m[0] != r[0]:
             return None
         if m[0] == "__obj__":
+            # Host objects take the LATEST recording and are served
+            # unchecked under generic replay: soundness relies on the
+            # consume_obj invariant (table.py) — every obj consumer is
+            # guarded by a downstream relation-checked consume that trips
+            # the violation flag if a stale object shaped results.
             out.append(r)
         elif m[0] == "rows":
             hi = max(m[1], r[1])
@@ -243,6 +248,7 @@ class FusedExecutor:
             entries = generic[1]
             cursor = [0]
             backend._replay_viol = None
+            backend._obj_unguarded = 0
             backend.count_mode = ("replay_gen", entries, cursor)
             try:
                 yield
@@ -252,6 +258,15 @@ class FusedExecutor:
                 raise FusedReplayMismatch(
                     f"generic replay consumed {cursor[0]} of "
                     f"{len(entries)} merged sizes — op sequence diverged")
+            if backend.config.debug_obj_guard and backend._obj_unguarded:
+                # consume_obj invariant (table.py): a served host object
+                # with no downstream relation-checked consume could shape
+                # results undetected — fail loudly in debug builds.
+                raise AssertionError(
+                    f"{backend._obj_unguarded} __obj__ entr"
+                    f"{'y' if backend._obj_unguarded == 1 else 'ies'} "
+                    "served under generic replay without a downstream "
+                    "relation-checked consume guarding them")
             viol = backend._replay_viol
             backend._replay_viol = None
             if viol is not None:

@@ -85,12 +85,21 @@ class DeviceBackend:
         self.syncs = 0  # device->host scalar materializations (perf metric)
         # set after a compiled dense-group kernel fails at runtime: later
         # group-bys skip straight to the sorted path instead of re-paying
-        # (and re-risking) a failing remote compile
+        # (and re-risking) a failing remote compile.  Transient (non-
+        # compile) errors don't latch it until they repeat — see
+        # _group_device; shapes that ran to completion once skip the
+        # first-run block_until_ready probe.
         self.dense_group_dead = False
+        self.dense_group_ok_shapes: set = set()
+        self.dense_group_transient_failures = 0
         # device bool scalar accumulated by generic-replay relation
         # checks (consume_count/_rows); the fused executor syncs it once
         # per query and re-records on violation
         self._replay_viol = None
+        # debug_obj_guard bookkeeping: __obj__ entries served under
+        # generic replay with no non-stat relation check after them yet
+        # (see consume_obj's invariant)
+        self._obj_unguarded = 0
         # Distributed-join accounting (SURVEY.md §5.5/§5.8): bytes moved
         # over ICI by hand-scheduled collectives (static shape estimates:
         # each exchanged/gathered buffer counted once per hop it crosses),
@@ -283,13 +292,31 @@ class DeviceBackend:
             bad = actual != served64
         self._replay_viol = (bad if self._replay_viol is None
                              else self._replay_viol | bad)
+        # any non-stat relation check downstream of a served __obj__
+        # counts as its guard (see consume_obj's invariant)
+        self._obj_unguarded = 0
 
     def consume_obj(self, make):
         """Materialize a small data-dependent HOST value (e.g. the hot-key
         sample of the radix dist join) through the same record/replay
         stream as sizes: eager/record mode runs ``make()`` (counting its
         sync), replay serves the recorded value with NO device round trip
-        — fused replays stay sync-free and ``be.syncs`` stays honest."""
+        — fused replays stay sync-free and ``be.syncs`` stays honest.
+
+        INVARIANT (ADVICE r5): an ``__obj__`` entry has no device-side
+        relation check of its own — under GENERIC replay the served host
+        object may be stale for the current parameter values, and nothing
+        here would notice.  Every consumer of ``consume_obj`` MUST
+        therefore be guarded by a downstream relation-checked consume
+        (``consume_count``/``consume_rows``/``consume_pred`` with a
+        relation other than ``"stat"``) that would trip the end-of-query
+        violation flag whenever the stale object could shape results —
+        e.g. the radix join consumes its hot-key sample and then checks
+        ``dropped == 0`` with relation ``"exact"``.  A consumer without
+        such a guard silently serves wrong results.  Debug builds
+        (``config.debug_obj_guard``) assert the guard exists: an obj
+        served under generic replay with no later non-stat check raises
+        at the end of the query (fused.py epilogue)."""
         mode = self.count_mode
         if mode is None:
             self.syncs += 1
@@ -299,11 +326,30 @@ class DeviceBackend:
             v = make()
             mode[1].append(("__obj__", v))
             return v
-        return self._next_entry(mode, "__obj__")[1]
+        v = self._next_entry(mode, "__obj__")[1]
+        if mode[0] == "replay_gen" and self.config.debug_obj_guard:
+            self._obj_unguarded += 1
+        return v
 
 
 class FusedReplayMismatch(RuntimeError):
     """The op sequence during fused replay diverged from the recording."""
+
+
+_TRANSIENT_ERROR_MARKERS = (
+    "resource_exhausted", "unavailable", "deadline_exceeded", "aborted",
+    "cancelled", "connection", "timeout", "timed out", "tunnel", "socket",
+    "transport",
+)
+
+
+def _transient_device_error(ex: Exception) -> bool:
+    """Heuristic triage of a device-execution failure: transient runtime
+    conditions (contention, transport hiccups) vs deterministic compile/
+    lowering failures.  Used to decide whether a kernel kill-flag may
+    latch on the first failure (deterministic) or only after repeats."""
+    msg = f"{type(ex).__name__}: {ex}".lower()
+    return any(m in msg for m in _TRANSIENT_ERROR_MARKERS)
 
 
 class DeviceTable(Table):
@@ -396,8 +442,21 @@ class DeviceTable(Table):
     def branch_empty(self) -> bool:
         if self._local is not None:
             return self._local.size == 0
+        mode = self.backend.count_mode
+        if self._live is not None and (mode is None or mode[0] == "record"):
+            # ADVICE r5: a table that escaped its fused activation (e.g.
+            # a generic-replay query result reused as a plain input) only
+            # knows a served UPPER bound in _n — it can be non-zero for
+            # an actually-empty table with no violation check running.
+            # The branch needs the exact count: pay the sync.  This
+            # applies in RECORD mode too: consume_pred would bake the
+            # stale bound into the recording as an "exact" branch, wrong
+            # on the recording run and on every replay of it.
+            host_empty = self._exact_n() == 0
+        else:
+            host_empty = self._n == 0
         return self.backend.consume_pred(
-            self._n == 0,
+            host_empty,
             lambda: (self._live if self._live is not None
                      else jnp.int32(self._n)) == 0)
 
@@ -1051,6 +1110,30 @@ class DeviceTable(Table):
         try:
             fast = (None if self.backend.dense_group_dead
                     else self._group_dense_pallas(by, aggs))
+            if fast is not None:
+                # the signature must separate every kernel VARIANT the
+                # dense path can compile: key-column kind changes the
+                # code domain (str: pool-sized, bool: 2) and agg-column
+                # kinds pick different lanes (i32-riding int64 min/max)
+                sig = (self.capacity, len(self.backend.pool),
+                       tuple(self._cols[c].kind for c in by
+                             if c in self._cols),
+                       tuple((a.kind, a.distinct,
+                              self._cols[a.col].kind
+                              if a.col in self._cols else None)
+                             for a in aggs))
+                if sig not in self.backend.dense_group_ok_shapes:
+                    # ADVICE r5: JAX dispatch is async — a Mosaic/runtime
+                    # kernel failure at a first-seen shape would surface
+                    # at a downstream transfer OUTSIDE this try and crash
+                    # the query instead of degrading to the sorted path.
+                    # Block the outputs once per shape signature; repeats
+                    # of a validated shape stay fully async.
+                    for col in fast._cols.values():
+                        col.data.block_until_ready()
+                        col.valid.block_until_ready()
+                    self.backend.dense_group_ok_shapes.add(sig)
+                self.backend.dense_group_transient_failures = 0
         except (UnsupportedOnDevice, FusedReplayMismatch):
             raise  # routed by group() / the fused executor, not this net
         except Exception as ex:
@@ -1062,10 +1145,21 @@ class DeviceTable(Table):
             # not JaxRuntimeError, hence the broad catch.  The kill flag
             # stops later group-bys from re-paying a failing remote
             # compile (each failed compile also risks wedging the tunnel
-            # — TUNNEL_r05.md probes #5/#7).
-            self.backend.dense_group_dead = True
+            # — TUNNEL_r05.md probes #5/#7) — but ADVICE r5: a TRANSIENT
+            # runtime error (contention, transport hiccup) must not
+            # disable the kernel for the whole session; only compile/
+            # lowering failures latch immediately, transients latch
+            # after 3 in a row.
+            transient = _transient_device_error(ex)
+            if transient:
+                self.backend.dense_group_transient_failures += 1
+                if self.backend.dense_group_transient_failures >= 3:
+                    self.backend.dense_group_dead = True
+            else:
+                self.backend.dense_group_dead = True
             self.backend.fallback_reasons.append(
-                f"dense group kernel failed at runtime: {str(ex)[:200]}")
+                f"dense group kernel failed at runtime"
+                f"{' (transient)' if transient else ''}: {str(ex)[:200]}")
             fast = None
         if fast is not None:
             return fast

@@ -10,6 +10,7 @@ reference got this from the external Neo4j front-end dependency.
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Tuple
 
 from caps_tpu.frontend import ast
@@ -749,6 +750,41 @@ class CypherParser:
         raise self.error(f"unexpected token {t.text or 'end of input'!r} in expression")
 
 
-def parse_query(query: str) -> ast.Statement:
-    """Parse a Cypher statement into the clause AST."""
+@functools.lru_cache(maxsize=512)
+def _parse_memo(query: str) -> ast.Statement:
     return CypherParser(query).parse_statement()
+
+
+def parse_query(query: str, memo: bool = True) -> ast.Statement:
+    """Parse a Cypher statement into the clause AST.
+
+    Parses are memoized per query text (the AST is a frozen tree, shared
+    safely across sessions); the memo is the first stage of the prepared
+    -statement fast path (relational/plan_cache.py).  ``memo=False``
+    forces a fresh parse (tests of the parser itself)."""
+    if memo:
+        return _parse_memo(query)
+    return CypherParser(query).parse_statement()
+
+
+@functools.lru_cache(maxsize=2048)
+def normalize_query(query: str) -> str:
+    """Token-level normal form of a query, safe as a plan-cache key:
+    whitespace and comments drop, keywords are case-folded (the lexer
+    upper-cases them), but string literals keep their EXACT parsed value
+    — naive whitespace collapsing would merge ``'a  b'`` with ``'a b'``
+    and serve wrong plans.  Unlexable text falls back to itself (the
+    parse will raise the real error downstream)."""
+    try:
+        toks = tokenize(query)
+    except CypherSyntaxError:
+        return query
+    parts = []
+    for t in toks:
+        if t.kind == EOF:
+            break
+        if t.kind in (STRING, INT, FLOAT):
+            parts.append(f"{t.kind}:{t.value!r}")
+        else:
+            parts.append(f"{t.kind}:{t.text}")
+    return " ".join(parts)

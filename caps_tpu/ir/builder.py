@@ -56,7 +56,10 @@ class IRBuilder:
                  parameters: Optional[Mapping[str, object]] = None):
         self.ambient_schema = ambient_schema
         self.schema_resolver = schema_resolver
-        self.parameters = dict(parameters or {})
+        # kept as-is (not copied): a PlanParams view must keep recording
+        # plan-time value reads for the plan cache (relational/plan_cache)
+        self.parameters: Mapping[str, object] = \
+            parameters if parameters is not None else {}
 
     # -- entry --------------------------------------------------------------
 
@@ -395,14 +398,26 @@ class _SingleQueryBuilder:
             for k, v in zip(props.keys, props.values):
                 out.append(E.Equals(E.Property(E.Var(var), k), v))
         elif isinstance(props, E.Param):
-            value = self.parent.parameters.get(props.name)
-            if isinstance(value, dict):
-                for k in value:
-                    out.append(E.Equals(E.Property(E.Var(var), k),
-                                        E.Index(props, E.Lit(k))))
+            # Pattern-property expansion depends on the map's KEY SET
+            # only (values flow through Index(param, key) at runtime):
+            # under a PlanParams view the key set is recorded as a cache
+            # specialization, so the plan is shared across bindings with
+            # the same keys and re-planned when the keys change.
+            params = self.parent.parameters
+            map_keys = getattr(params, "map_keys", None)
+            if map_keys is not None:
+                keys = map_keys(props.name)
             else:
+                value = params.get(props.name) if hasattr(params, "get") \
+                    else None
+                keys = tuple(sorted(value)) if isinstance(value, dict) \
+                    else None
+            if keys is None:
                 raise IRBuildError(
                     f"pattern property parameter ${props.name} must be a map")
+            for k in keys:
+                out.append(E.Equals(E.Property(E.Var(var), k),
+                                    E.Index(props, E.Lit(k))))
         else:
             raise IRBuildError("pattern properties must be a map literal or parameter")
 

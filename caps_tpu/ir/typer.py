@@ -27,7 +27,10 @@ class SchemaTyper:
     def __init__(self, schema: Schema,
                  parameters: Optional[Mapping[str, object]] = None):
         self.schema = schema
-        self.parameters = dict(parameters or {})
+        # kept as-is (not copied): a PlanParams view must keep recording
+        # plan-time value reads for the plan cache (relational/plan_cache)
+        self.parameters: Mapping[str, object] = \
+            parameters if parameters is not None else {}
 
     def type_of(self, expr: E.Expr, env: Mapping[str, CypherType]) -> CypherType:
         t = self._type_of(expr, env)
@@ -43,6 +46,13 @@ class SchemaTyper:
                 raise TypingError(f"variable `{e.name}` not in scope")
             return env[e.name]
         if isinstance(e, E.Param):
+            # Only the COARSE type of a parameter is consumed here: go
+            # through the type-level accessor when planning under a
+            # PlanParams view so the read keys the plan by signature, not
+            # by value (plain dicts use the value directly).
+            coarse = getattr(self.parameters, "coarse_type", None)
+            if coarse is not None:
+                return coarse(e.name) or CTAny
             if e.name in self.parameters:
                 return from_python(self.parameters[e.name])
             return CTAny

@@ -38,7 +38,10 @@ class LogicalPlanner:
                  parameters: Opt[Mapping[str, object]] = None):
         self.ambient_schema = ambient_schema
         self.schema_resolver = schema_resolver
-        self.parameters = dict(parameters or {})
+        # kept as-is (not copied): a PlanParams view must keep recording
+        # plan-time value reads for the plan cache (relational/plan_cache)
+        self.parameters: Mapping[str, object] = \
+            parameters if parameters is not None else {}
 
     def process(self, stmt: B.CypherStatement) -> L.LogicalPlan:
         if isinstance(stmt, B.CypherQuery):

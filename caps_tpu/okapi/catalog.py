@@ -56,7 +56,20 @@ class CypherCatalog(PropertyGraphCatalog):
             Namespace(): SessionGraphDataSource()
         }
         # bumped on every mutation; part of the fused executor's plan key
+        # and the session plan cache's catalog fingerprint
         self.version = 0
+        self._listeners: list = []
+
+    def subscribe(self, fn) -> None:
+        """Register a callback invoked with the new version after every
+        catalog mutation (the session plan cache evicts dependent
+        entries through this)."""
+        self._listeners.append(fn)
+
+    def _bump(self) -> None:
+        self.version += 1
+        for fn in list(self._listeners):
+            fn(self.version)
 
     @property
     def session_namespace(self) -> Namespace:
@@ -68,14 +81,15 @@ class CypherCatalog(PropertyGraphCatalog):
         if namespace in self._sources:
             raise ValueError(f"namespace {namespace!r} already registered")
         self._sources[namespace] = source
-        self.version += 1
+        self._bump()
 
     def deregister_source(self, namespace: Namespace) -> None:
         if isinstance(namespace, str):
             namespace = Namespace(namespace)
         if namespace == Namespace():
             raise ValueError("cannot deregister the session namespace")
-        self._sources.pop(namespace, None)
+        if self._sources.pop(namespace, None) is not None:
+            self._bump()  # resolvable graphs changed: dependents are stale
 
     def source(self, namespace: Namespace) -> PropertyGraphDataSource:
         if isinstance(namespace, str):
@@ -102,12 +116,12 @@ class CypherCatalog(PropertyGraphCatalog):
     def store(self, name: NameLike, graph: PropertyGraph) -> None:
         qgn = _qualify(name)
         self.source(qgn.namespace).store(qgn.graph_name, graph)
-        self.version += 1
+        self._bump()
 
     def delete(self, name: NameLike) -> None:
         qgn = _qualify(name)
         self.source(qgn.namespace).delete(qgn.graph_name)
-        self.version += 1
+        self._bump()
 
     def graph_names(self) -> Tuple[QualifiedGraphName, ...]:
         out = []

@@ -112,6 +112,21 @@ class EngineConfig:
     # Compile-cache capacity (query programs keyed by plan+bucket shapes)
     compile_cache_size: int = dataclasses.field(
         default_factory=lambda: _env_int("CAPS_TPU_COMPILE_CACHE", 512))
+    # Prepared-statement plan cache (relational/plan_cache.py): repeated
+    # parameterized queries skip parse/IR/logical/relational planning
+    # entirely on a hit — the last un-amortized scalar hot path in the
+    # pipelined serving mode.  Keys are value-independent (query text +
+    # graph + catalog fingerprint + parameter signature).
+    use_plan_cache: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_PLAN_CACHE", True))
+    # Max cached plans per session (LRU evicted beyond this).
+    plan_cache_size: int = dataclasses.field(
+        default_factory=lambda: _env_int("CAPS_TPU_PLAN_CACHE_SIZE", 256))
+    # Debug assertion hook for the generic-replay __obj__ invariant
+    # (backends/tpu/fused.py): an obj served under generic replay that no
+    # downstream relation-checked consume guards raises at query end.
+    debug_obj_guard: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_DEBUG_OBJ_GUARD", False))
     # Persistent XLA compilation cache directory ("" = disabled).  Repeat
     # processes skip device compiles entirely — on remote-compile
     # transports this turns a ~100 s cold start into seconds.

@@ -179,7 +179,7 @@ def dense_segment_agg(codes: jnp.ndarray, ok: jnp.ndarray,
 
 @functools.lru_cache(maxsize=256)
 def _sharded_agg_fn(mesh, num_segments: int, kind: str, interpret: bool):
-    from jax import shard_map
+    from caps_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     # rows split over EVERY mesh axis (matches DeviceBackend.place_rows):

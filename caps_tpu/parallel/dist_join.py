@@ -57,7 +57,7 @@ jax.config.update("jax_enable_x64", True)  # int64 join keys/sentinels
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from caps_tpu.parallel.compat import shard_map
 
 from caps_tpu.parallel.collectives import (
     bin_positions as _bin_positions,

@@ -23,7 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from caps_tpu.parallel.compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -46,8 +46,8 @@ def _ring_hop(cnt_block, edge_src, edge_dst, edge_ok, *, axis: str,
 
     # the accumulator becomes device-varying on the first iteration, so the
     # loop carry must start with matching vma type
-    acc0 = jax.lax.pcast(jnp.zeros(edge_src.shape, cnt_block.dtype), axis,
-                         to="varying")
+    acc0 = pcast(jnp.zeros(edge_src.shape, cnt_block.dtype), axis,
+                 to="varying")
     _, per_edge = jax.lax.fori_loop(0, n_shards, body, (cnt_block, acc0))
     local_out = jax.ops.segment_sum(per_edge, edge_dst,
                                     num_segments=n_nodes)
@@ -139,7 +139,7 @@ def _ring_hop_matrix(f_block, edge_src, edge_dst, edge_ok, *, axis: str,
         blk = jax.lax.ppermute(blk, axis, perm)
         return blk, acc
 
-    acc0 = jax.lax.pcast(
+    acc0 = pcast(
         jnp.zeros((n_seeds, edge_src.shape[0]), f_block.dtype), axis,
         to="varying")
     _, per_edge = jax.lax.fori_loop(0, n_shards, body, (f_block, acc0))

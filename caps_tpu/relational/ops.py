@@ -64,7 +64,16 @@ class EntityContext:
 
 class RelationalRuntimeContext:
     """Per-query context: parameters, session, catalog view (ref:
-    ``RelationalRuntimeContext`` — SURVEY.md §2)."""
+    ``RelationalRuntimeContext`` — SURVEY.md §2).
+
+    Parameter VALUES are late-bound: every operator reads
+    ``context.parameters`` inside ``_compute`` (filters, projections,
+    SKIP/LIMIT counts, percentile args), never at plan-construction time.
+    That contract is what lets the session plan cache
+    (relational/plan_cache.py) re-execute one planned operator tree for
+    every binding of the same parameter signature — new plan-time value
+    reads must go through the PlanParams view instead so they are
+    recorded in the cache key."""
 
     def __init__(self, session, parameters: Optional[Mapping[str, Any]] = None):
         self.session = session
@@ -72,6 +81,16 @@ class RelationalRuntimeContext:
         # per-operator wall-clock + row counts, filled as ops evaluate
         # (SURVEY.md §5.1 — the structured analog of the Spark UI stage view)
         self.op_metrics: List[Dict[str, Any]] = []
+
+    def rebind(self, parameters: Mapping[str, Any]) -> None:
+        """Swap in fresh parameter bindings for a cached-plan
+        re-execution: operators hold a reference to THIS context, so an
+        in-place update reaches every ``_compute``; per-run operator
+        metrics start fresh (the previous run's list stays owned by the
+        result that captured it)."""
+        self.parameters.clear()
+        self.parameters.update(parameters)
+        self.op_metrics = []
 
     @property
     def factory(self):

@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 logger = logging.getLogger("caps_tpu")
 
-from caps_tpu.frontend.parser import parse_query
+from caps_tpu.frontend.parser import normalize_query, parse_query
 from caps_tpu.ir import blocks as B
 from caps_tpu.ir import exprs as E
 from caps_tpu.ir.builder import IRBuilder
@@ -34,6 +34,10 @@ from caps_tpu.okapi.values import CypherNode, CypherPath, CypherRelationship
 from caps_tpu.relational import ops as R
 from caps_tpu.relational.graphs import EmptyGraph, RelationalCypherGraph, ScanGraph
 from caps_tpu.relational.header import RecordHeader
+from caps_tpu.relational.plan_cache import (
+    CachedPlan, PlanCache, PlanParams, PreparedQuery, _plan_nbytes,
+    graph_plan_token, param_signature, reset_plan,
+)
 from caps_tpu.relational.planner import RelationalPlanner
 from caps_tpu.relational.table import Table, TableFactory
 
@@ -263,6 +267,11 @@ class RelationalCypherSession(CypherSession):
         self._catalog = CypherCatalog()
         self.config = config or DEFAULT_CONFIG
         self._ambient = EmptyGraph(self)
+        # Prepared-statement plan cache (relational/plan_cache.py): keyed
+        # value-independently; catalog mutations evict dependent entries.
+        self.plan_cache = PlanCache(self.config.plan_cache_size,
+                                    enabled=self.config.use_plan_cache)
+        self._catalog.subscribe(self.plan_cache.evict_stale)
 
     # -- backend SPI --------------------------------------------------------
 
@@ -281,6 +290,14 @@ class RelationalCypherSession(CypherSession):
                parameters: Optional[Mapping[str, Any]] = None) -> CypherResult:
         return self.cypher_on_graph(self._ambient, query, parameters)
 
+    def prepare(self, query: str,
+                graph: Optional[RelationalCypherGraph] = None) -> PreparedQuery:
+        """Prepare a query for repeated execution: parses (and validates)
+        once, and every ``.run(params)`` serves the planned operator tree
+        from the session plan cache — the steady-state serving path skips
+        parse/IR/logical/relational planning entirely."""
+        return PreparedQuery(self, query, graph)
+
     def cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
                         parameters: Optional[Mapping[str, Any]] = None
                         ) -> CypherResult:
@@ -298,15 +315,38 @@ class RelationalCypherSession(CypherSession):
             result.metrics["determinism_digest"] = d1
         return result
 
+    def _plan_cache_key(self, graph: RelationalCypherGraph, query: str,
+                        params: Mapping[str, Any]) -> Optional[Tuple]:
+        gtok = graph_plan_token(graph)
+        if gtok is None:
+            return None
+        return (normalize_query(query), gtok, self._catalog.version,
+                param_signature(params))
+
     def _cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
                          parameters: Optional[Mapping[str, Any]] = None
                          ) -> CypherResult:
         t0 = time.perf_counter()
         params = dict(parameters or {})
+
+        cache_key: Optional[Tuple] = None
+        if self.plan_cache.enabled:
+            cache_key = self._plan_cache_key(graph, query, params)
+            if cache_key is not None:
+                cached = self.plan_cache.lookup(cache_key, params)
+                if cached is not None:
+                    return self._run_cached(cached, query, params, t0)
+
+        # Cold path: the full frontend.  Planning sees the parameters
+        # through a PlanParams view, which records any plan-time VALUE
+        # read as a cache specialization; runtime parameter reads go
+        # through the context's plain dict and stay free.
+        plan_params = PlanParams(params)
         stmt = parse_query(query)
 
         t1 = time.perf_counter()
-        ir = IRBuilder(graph.schema, self._schema_resolver, params).process(stmt)
+        ir = IRBuilder(graph.schema, self._schema_resolver,
+                       plan_params).process(stmt)
         t2 = time.perf_counter()
 
         if isinstance(ir, B.CreateGraphStatement):
@@ -316,7 +356,7 @@ class RelationalCypherSession(CypherSession):
             return RelationalCypherResult()
 
         logical = LogicalPlanner(graph.schema, self._schema_resolver,
-                                 params).process(ir)
+                                 plan_params).process(ir)
         logical = LogicalOptimizer().process(logical)
         t3 = time.perf_counter()
 
@@ -356,12 +396,67 @@ class RelationalCypherSession(CypherSession):
             # memory; achieved GB/s = bytes_touched / execute_s
             "bytes_touched": sum(m.get("bytes_in", 0)
                                  for m in context.op_metrics),
+            "plan_cache": "miss" if cache_key is not None else "off",
         }
         if self.config.print_timings:
             print(f"[caps-tpu] timings: {metrics}")
         logger.debug("query %r: %d rows in %.1f ms", query,
                      metrics["rows"], 1e3 * (t5 - t0))
+
+        if (cache_key is not None and records is not None
+                and not logical.returns_graph and plan_params.cacheable):
+            entry = CachedPlan(
+                root=root, result_fields=logical.result_fields, plans=plans,
+                records_graph=rel_planner.current_graph, context=context,
+                spec_key=plan_params.spec_key(),
+                cold_phase_s=t4 - t0, nbytes=_plan_nbytes(plans, root))
+            # Drop the memoized results before parking the tree in the
+            # cache: the records object holds the (header, table) refs,
+            # so a cached plan retains no tables between executions.
+            reset_plan(root)
+            self.plan_cache.store(cache_key, entry)
         return RelationalCypherResult(records, result_graph, plans, metrics)
+
+    def _run_cached(self, plan: CachedPlan, query: str,
+                    params: Dict[str, Any], t0: float) -> CypherResult:
+        """Execute a cached relational operator tree with fresh parameter
+        bindings: swap the shared runtime context's parameters, clear the
+        per-run memos, and pull the root's result.  parse/ir/plan/
+        relational metrics are ~0 by construction (only the cache lookup
+        preceded this)."""
+        context = plan.context
+        context.rebind(params)
+        reset_plan(plan.root)
+        t1 = time.perf_counter()
+        header, table = plan.root.result
+        records = RelationalCypherRecords(
+            self, header, table, plan.result_fields, graph=plan.records_graph)
+        t2 = time.perf_counter()
+        if self.config.print_ir:
+            print(plan.plans["ir"])
+        if self.config.print_logical_plan:
+            print(plan.plans["logical"])
+        if self.config.print_relational_plan:
+            print(plan.plans["relational"])
+        metrics = {
+            "parse_s": 0.0, "ir_s": 0.0, "plan_s": 0.0, "relational_s": 0.0,
+            "plan_cache_lookup_s": t1 - t0,
+            "execute_s": t2 - t1,
+            "rows": table.size_hint(),
+            "operators": context.op_metrics,
+            "bytes_touched": sum(m.get("bytes_in", 0)
+                                 for m in context.op_metrics),
+            "plan_cache": "hit",
+            "plan_cache_saved_s": plan.cold_phase_s,
+        }
+        # the records object owns (header, table) now; the parked tree
+        # must not pin device buffers until its next execution
+        reset_plan(plan.root)
+        if self.config.print_timings:
+            print(f"[caps-tpu] timings: {metrics}")
+        logger.debug("query %r: %d rows in %.1f ms (plan cache hit)",
+                     query, metrics["rows"], 1e3 * (t2 - t0))
+        return RelationalCypherResult(records, None, plan.plans, metrics)
 
     # -- graph-returning statements -----------------------------------------
 

@@ -4,12 +4,17 @@ reuse across parameter values — reconstructed, mount empty;
 SURVEY.md §3.1).
 
 An interactive service runs the SAME query text with rotating
-parameters (the LDBC short-read shape). On a remote TPU transport the
-dominant steady-state cost is device→host size syncs; the engine's
-param-generic fused replay converges those to ~1 per query regardless
-of parameter value, while keeping results exact (device-checked served
-sizes; a parameter whose sizes exceed every recorded bound
-transparently re-records).
+parameters (the LDBC short-read shape).  Two session caches amortize
+that shape end to end:
+
+* the **plan cache** (``session.prepare``): parse → IR → logical →
+  relational planning runs once; every later ``.run(params)`` re-binds
+  parameter values into the cached operator tree (keys are
+  value-independent — names + coarse types);
+* the **fused executor** (TPU backend): device→host size syncs converge
+  to ~1 per query regardless of parameter value, while keeping results
+  exact (device-checked served sizes; a parameter whose sizes exceed
+  every recorded bound transparently re-records).
 
 Run:  python examples/parameterized_reads.py
 """
@@ -28,17 +33,23 @@ def main(backend: str = "tpu"):
                (cleo)-[:KNOWS]->(dev), (dev)-[:KNOWS]->(ana),
                (ana)-[:KNOWS]->(cleo)
     """)
-    query = ("MATCH (a:Person)-[:KNOWS]->(b:Person) "
-             "WHERE a.age > $min_age "
-             "RETURN a.name AS person, b.name AS knows ORDER BY person, knows")
+    prepared = graph.prepare(
+        "MATCH (a:Person)-[:KNOWS]->(b:Person) "
+        "WHERE a.age > $min_age "
+        "RETURN a.name AS person, b.name AS knows ORDER BY person, knows")
     out = []
     for min_age in (30, 40, 25, 50, 30):
-        result = graph.cypher(query, {"min_age": min_age})
+        result = prepared.run({"min_age": min_age})
         rows = result.records.to_maps()
-        syncs = (result.metrics or {}).get("size_syncs")
+        metrics = result.metrics or {}
+        syncs = metrics.get("size_syncs")
         out.append((min_age, len(rows), syncs))
-        print(f"min_age={min_age}: {len(rows)} rows"
+        print(f"min_age={min_age}: {len(rows)} rows, "
+              f"plan_cache={metrics.get('plan_cache')}"
               + (f", {syncs} host syncs" if syncs is not None else ""))
+    stats = session.plan_cache.stats()
+    print(f"plan cache: {stats['hits']} hits / {stats['misses']} misses, "
+          f"{stats['saved_s'] * 1e3:.2f} ms of planning skipped")
     return out
 
 

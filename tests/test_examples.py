@@ -45,3 +45,12 @@ def test_fs_datasource():
     import fs_datasource
     rows = fs_datasource.main()
     assert rows == [{"n": "Kyoto"}]
+
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_parameterized_reads(backend):
+    import parameterized_reads
+    out = parameterized_reads.main(backend)
+    # (min_age, row count, size_syncs) per rotation of the prepared query
+    assert [(m, n) for m, n, _ in out] == [
+        (30, 4), (40, 2), (25, 5), (50, 1), (30, 4)]

@@ -1,0 +1,261 @@
+"""Prepared statements + the session-level LRU plan cache
+(caps_tpu/relational/plan_cache.py).
+
+Correctness contract under test: a cached plan executed with NEW
+parameter bindings returns results identical to a fresh cold-path run of
+the same query; catalog mutations invalidate dependent entries; the
+determinism check passes through the cached path; eviction is LRU at
+``plan_cache_size``.
+"""
+from __future__ import annotations
+
+import pytest
+
+import caps_tpu
+from caps_tpu.okapi.config import EngineConfig
+from caps_tpu.testing.factory import create_graph
+
+SOCIAL = """
+    CREATE (a:Person {name: 'Alice', age: 33}),
+           (b:Person {name: 'Bob', age: 44}),
+           (c:Person {name: 'Carol', age: 27}),
+           (a)-[:KNOWS {since: 2011}]->(b),
+           (b)-[:KNOWS {since: 2015}]->(c),
+           (a)-[:KNOWS {since: 2019}]->(c)
+"""
+
+
+def _session(backend="local", **cfg):
+    return caps_tpu.local_session(backend=backend,
+                                  config=EngineConfig(**cfg) if cfg else None)
+
+
+def _rows(result):
+    return result.records.to_maps()
+
+
+def _bag(rows):
+    return sorted(sorted(r.items()) for r in rows)
+
+
+# -- cached results == cold-path results, across param values --------------
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_cached_plan_matches_cold_run_per_binding(backend):
+    session = _session(backend)
+    graph = create_graph(session, SOCIAL)
+    q = ("MATCH (a:Person)-[:KNOWS]->(b) WHERE a.age > $min "
+         "RETURN a.name AS a, b.name AS b")
+    for min_age in (30, 40, 20, 50, 30):
+        got = graph.cypher(q, {"min": min_age})
+        # fresh cold-path run of the SAME query and bindings
+        session.plan_cache.enabled = False
+        try:
+            want = graph.cypher(q, {"min": min_age})
+        finally:
+            session.plan_cache.enabled = True
+        assert _bag(_rows(got)) == _bag(_rows(want)), min_age
+    stats = session.plan_cache.stats()
+    assert stats["hits"] >= 4 and stats["misses"] == 1
+
+
+def test_hit_skips_every_planning_phase():
+    session = _session()
+    graph = create_graph(session, SOCIAL)
+    q = "MATCH (p:Person) WHERE p.age > $x RETURN p.name AS n ORDER BY n"
+    miss = graph.cypher(q, {"x": 30})
+    assert miss.metrics["plan_cache"] == "miss"
+    assert miss.metrics["plan_s"] > 0
+    hit = graph.cypher(q, {"x": 40})
+    assert hit.metrics["plan_cache"] == "hit"
+    assert (hit.metrics["parse_s"] + hit.metrics["ir_s"]
+            + hit.metrics["plan_s"] + hit.metrics["relational_s"]) == 0.0
+    assert hit.metrics["plan_cache_saved_s"] > 0
+    assert _rows(hit) == [{"n": "Bob"}]
+    # explain still works from the cached plans
+    assert "=== RELATIONAL ===" in hit.explain()
+
+
+def test_runtime_bound_params_in_limit_and_unwind():
+    session = _session()
+    graph = create_graph(session, SOCIAL)
+    lim = "MATCH (p:Person) RETURN p.name AS n ORDER BY n LIMIT $k"
+    assert [r["n"] for r in _rows(graph.cypher(lim, {"k": 1}))] == ["Alice"]
+    res = graph.cypher(lim, {"k": 2})
+    assert res.metrics["plan_cache"] == "hit"
+    assert [r["n"] for r in _rows(res)] == ["Alice", "Bob"]
+
+    unw = "UNWIND $xs AS x RETURN x ORDER BY x"
+    assert [r["x"] for r in _rows(session.cypher(unw, {"xs": [3, 1, 2]}))] \
+        == [1, 2, 3]
+    res = session.cypher(unw, {"xs": [5, 4]})
+    assert res.metrics["plan_cache"] == "hit"
+    assert [r["x"] for r in _rows(res)] == [4, 5]
+
+
+def test_param_signature_keys_by_coarse_type():
+    session = _session()
+    q = "RETURN $x AS x"
+    assert _rows(session.cypher(q, {"x": 1})) == [{"x": 1}]
+    assert _rows(session.cypher(q, {"x": "a"})) == [{"x": "a"}]
+    assert _rows(session.cypher(q, {"x": 2})) == [{"x": 2}]
+    stats = session.plan_cache.stats()
+    # int and string signatures plan separately; the second int hits
+    assert stats["misses"] == 2 and stats["hits"] == 1
+    assert stats["entries"] == 2
+
+
+def test_map_param_specializes_on_key_set():
+    session = _session()
+    graph = create_graph(session, SOCIAL)
+    q = "MATCH (n:Person $props) RETURN n.age AS age"
+    assert _rows(graph.cypher(q, {"props": {"name": "Alice"}})) \
+        == [{"age": 33}]
+    # same key set, different value: plan is shared
+    res = graph.cypher(q, {"props": {"name": "Bob"}})
+    assert res.metrics["plan_cache"] == "hit"
+    assert _rows(res) == [{"age": 44}]
+    # different key set: the specialized plan must NOT be served stale
+    res = graph.cypher(q, {"props": {"age": 27}})
+    assert res.metrics["plan_cache"] == "miss"
+    assert _rows(res) == [{"age": 27}]
+    # and the new specialization is itself cached
+    res = graph.cypher(q, {"props": {"age": 44}})
+    assert res.metrics["plan_cache"] == "hit"
+    assert _rows(res) == [{"age": 44}]
+
+
+# -- normalization ---------------------------------------------------------
+
+def test_whitespace_and_comments_normalize_to_one_entry():
+    session = _session()
+    graph = create_graph(session, SOCIAL)
+    r1 = graph.cypher("MATCH (p:Person) RETURN count(*) AS c")
+    r2 = graph.cypher(
+        "MATCH  (p:Person)  // comment\n   RETURN count(*)   AS c")
+    assert r2.metrics["plan_cache"] == "hit"
+    assert _rows(r1) == _rows(r2) == [{"c": 3}]
+
+
+def test_string_literals_do_not_falsely_normalize():
+    session = _session()
+    r1 = session.cypher("RETURN 'a b' AS s")
+    r2 = session.cypher("RETURN 'a  b' AS s")
+    assert _rows(r1) == [{"s": "a b"}]
+    assert _rows(r2) == [{"s": "a  b"}]
+
+
+# -- invalidation ----------------------------------------------------------
+
+def test_catalog_create_drop_invalidates():
+    session = _session()
+    g1 = create_graph(session, "CREATE (:Person {name: 'A'})")
+    session.catalog.store("g", g1)
+    q = "FROM GRAPH session.g MATCH (n:Person) RETURN count(*) AS c"
+    assert _rows(session.cypher(q)) == [{"c": 1}]
+    assert session.cypher(q).metrics["plan_cache"] == "hit"
+    before = session.plan_cache.stats()
+
+    # CATALOG mutation: replacing the stored graph bumps the fingerprint
+    g2 = create_graph(session,
+                      "CREATE (:Person {name: 'B'}), (:Person {name: 'C'})")
+    session.catalog.store("g", g2)
+    after = session.plan_cache.stats()
+    assert after["invalidations"] > before["invalidations"]
+    res = session.cypher(q)
+    assert res.metrics["plan_cache"] == "miss"
+    assert _rows(res) == [{"c": 2}]
+
+    # CATALOG DELETE through the query surface also invalidates
+    session.cypher("CATALOG DELETE GRAPH session.g")
+    assert session.plan_cache.stats()["invalidations"] > after["invalidations"]
+    with pytest.raises(Exception):
+        session.cypher(q)
+
+
+def test_catalog_create_graph_statement_invalidates():
+    session = _session()
+    base = create_graph(session, "CREATE (:Person {name: 'A'})")
+    session.catalog.store("base", base)
+    q = "FROM GRAPH session.base MATCH (n) RETURN count(*) AS c"
+    assert _rows(session.cypher(q)) == [{"c": 1}]
+    entries_before = session.plan_cache.stats()["entries"]
+    assert entries_before >= 1
+    session.cypher("CATALOG CREATE GRAPH copy { "
+                   "FROM GRAPH session.base RETURN GRAPH }")
+    # the CREATE bumped the catalog fingerprint: dependents evicted
+    assert session.plan_cache.stats()["entries"] == 0
+    assert _rows(session.cypher(q)) == [{"c": 1}]
+
+
+# -- LRU -------------------------------------------------------------------
+
+def test_lru_eviction_at_plan_cache_size():
+    session = _session(plan_cache_size=2)
+    graph = create_graph(session, SOCIAL)
+    q1 = "MATCH (n:Person) RETURN count(*) AS c"
+    q2 = "MATCH (n:Person) WHERE n.age > 30 RETURN count(*) AS c"
+    q3 = "MATCH (n:Person) WHERE n.age < 30 RETURN count(*) AS c"
+    graph.cypher(q1)
+    graph.cypher(q2)
+    graph.cypher(q3)  # evicts q1 (LRU)
+    stats = session.plan_cache.stats()
+    assert stats["entries"] == 2 and stats["evictions"] == 1
+    assert graph.cypher(q3).metrics["plan_cache"] == "hit"
+    assert graph.cypher(q1).metrics["plan_cache"] == "miss"
+    assert _rows(graph.cypher(q1)) == [{"c": 3}]
+
+
+# -- determinism check / config toggles ------------------------------------
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_determinism_check_through_cached_path(backend):
+    session = _session(backend, determinism_check=True)
+    graph = create_graph(session, SOCIAL)
+    q = ("MATCH (a:Person)-[:KNOWS]->(b) WHERE a.age > $min "
+         "RETURN b.name AS n")
+    first = graph.cypher(q, {"min": 30})
+    assert "determinism_digest" in first.metrics
+    again = graph.cypher(q, {"min": 20})  # replay runs through the cache
+    assert "determinism_digest" in again.metrics
+    assert session.plan_cache.stats()["hits"] >= 2
+
+
+def test_plan_cache_disabled_by_config():
+    session = _session(use_plan_cache=False)
+    graph = create_graph(session, SOCIAL)
+    q = "MATCH (n:Person) RETURN count(*) AS c"
+    assert graph.cypher(q).metrics["plan_cache"] == "off"
+    assert graph.cypher(q).metrics["plan_cache"] == "off"
+    assert session.plan_cache.stats()["hits"] == 0
+
+
+# -- prepared statement API ------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_prepared_query_api(backend):
+    session = _session(backend)
+    graph = create_graph(session, SOCIAL)
+    prep = graph.prepare("MATCH (p:Person) WHERE p.age >= $min "
+                         "RETURN p.name AS n ORDER BY n")
+    assert [r["n"] for r in _rows(prep.run({"min": 40}))] == ["Bob"]
+    res = prep.run({"min": 30})
+    assert res.metrics["plan_cache"] == "hit"
+    assert [r["n"] for r in _rows(res)] == ["Alice", "Bob"]
+    # session.prepare on the ambient graph
+    p2 = session.prepare("RETURN $v AS v")
+    assert _rows(p2.run({"v": 7})) == [{"v": 7}]
+    assert _rows(p2.run({"v": 8})) == [{"v": 8}]
+
+
+def test_prepare_validates_syntax_eagerly():
+    session = _session()
+    with pytest.raises(Exception):
+        session.prepare("MATCH (n RETURN n")
+
+
+def test_stats_shape():
+    session = _session()
+    stats = session.plan_cache.stats()
+    assert set(stats) >= {"entries", "hits", "misses", "evictions",
+                          "invalidations", "hit_rate", "bytes", "saved_s"}
