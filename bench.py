@@ -251,12 +251,17 @@ def run_prepared_pipelined(session, graph, seeds, expected, batch: int):
     param-generic size stream over every seed): once with the plan cache
     disabled — per-query planning un-amortized — and once through the
     cache.  The delta isolates the planning amortization.  Returns
-    (cached seconds/query, uncached seconds/query, info dict)."""
+    (cached seconds/query, uncached seconds/query, info dict).
+
+    Cache/planning counters come from ``session.metrics_snapshot()``
+    diffs (caps_tpu/obs/) — the bench no longer hand-rolls its own
+    before/after counter plumbing."""
     import jax.numpy as jnp
     import numpy as np
     from caps_tpu.ir import exprs as E
+    from caps_tpu.obs import diff_snapshots
     prep = session.prepare(PARAM_QUERY, graph=graph)
-    stats0 = session.plan_cache.stats()
+    snap0 = session.metrics_snapshot()
     for s in seeds:
         # warmup: 1 plan-cache miss total, and one fused recording per
         # seed value (the generic stream's caps widen to the max)
@@ -283,10 +288,10 @@ def run_prepared_pipelined(session, graph, seeds, expected, batch: int):
     finally:
         session.plan_cache.enabled = True
     prep_s = one_phase(batch)
-    stats1 = session.plan_cache.stats()
-    hits = stats1["hits"] - stats0["hits"]
-    misses = stats1["misses"] - stats0["misses"]
-    saved = stats1["saved_s"] - stats0["saved_s"]
+    delta = diff_snapshots(snap0, session.metrics_snapshot())
+    hits = delta["plan_cache.hits"]
+    misses = delta["plan_cache.misses"]
+    saved = delta["plan_cache.saved_s"]
     attempts = hits + misses
     cold_s = saved / hits if hits else 0.0  # one cold plan's frontend cost
     info = {
@@ -295,6 +300,8 @@ def run_prepared_pipelined(session, graph, seeds, expected, batch: int):
         "plan_s_amortized": round(cold_s * misses / attempts, 6)
         if attempts else 0.0,
         "plan_cache_saved_s": round(saved, 4),
+        # sync-free replays over the measured interval, same snapshot
+        "fused_generic_replays": delta.get("fused.generic_replays", 0),
     }
     return prep_s, uncached_s, info
 

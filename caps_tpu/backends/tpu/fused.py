@@ -159,6 +159,11 @@ class FusedExecutor:
         self.replays = 0
         self.generic_replays = 0
         self.mismatches = 0
+        # mode of the most recent run() — "record" | "replay" |
+        # "replay_gen" | None (no key / nested).  The session's PROFILE
+        # path reads this to label span granularity honestly
+        # (per-op times under replay are host dispatch, not device).
+        self.last_mode: Optional[str] = None
 
     def key(self, graph, query: str,
             params: Mapping[str, Any]) -> Optional[Tuple]:
@@ -193,6 +198,7 @@ class FusedExecutor:
                 # violation-flag sync can batch with the result table's
                 # exact-count read (one transfer instead of two)
                 state["result"] = result
+                self.last_mode = state["mode"]
                 return result
         except Exception:
             if state["mode"] not in ("replay", "replay_gen"):
@@ -210,6 +216,7 @@ class FusedExecutor:
                     g[2] += 1
             else:
                 self._memo.pop(key, None)
+            self.last_mode = "record"
             with self._activate(key, {"mode": None}, force_record=True):
                 return thunk()
 

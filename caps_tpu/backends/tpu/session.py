@@ -8,7 +8,9 @@ assert the hot path stayed on-device.
 """
 from __future__ import annotations
 
+from caps_tpu import obs
 from caps_tpu.backends.tpu.table import DeviceBackend, DeviceTableFactory
+from caps_tpu.obs import clock
 from caps_tpu.okapi.config import DEFAULT_CONFIG
 from caps_tpu.relational.session import RelationalCypherSession
 
@@ -62,7 +64,69 @@ class TPUCypherSession(RelationalCypherSession):
             if self.config.use_fused:
                 result.metrics["fused_generic_replays"] = \
                     self.fused.generic_replays - before[7]
+        if self._profiling:
+            self._annotate_profile(result)
         return result
+
+    def _annotate_profile(self, result) -> None:
+        """Fused-replay-aware PROFILE epilogue (never silently wrong
+        numbers): when the query REPLAYED and per-op device sync was off,
+        per-operator spans measured only host dispatch of an async
+        stream — tag them so, and report device time as ONE per-replay
+        aggregate span (a block_until_ready delta over the result
+        table).  Eager/record runs (and per-op-sync profiles) already
+        carry honest per-op times."""
+        mode = self.fused.last_mode if self.config.use_fused else None
+        if result.metrics is not None:
+            result.metrics["fused_mode"] = mode or "eager"
+        replayed = mode in ("replay", "replay_gen")
+        per_op_device = self.tracer.sync_device
+        if result.profile is not None:
+            obs.tag_timing(result.profile,
+                           "device" if per_op_device else
+                           ("dispatch" if replayed else "host"))
+        if replayed and not per_op_device and result.records is not None:
+            t0 = clock.now()
+            result.records.table.device_sync()
+            device_s = clock.now() - t0
+            self.tracer.event("fused_replay.aggregate", kind="phase",
+                              device_s=device_s, fused_mode=mode)
+            if result.metrics is not None:
+                result.metrics["replay_device_s"] = device_s
+            if result.profile is not None:
+                result.profile["replay_device_s"] = device_s
+                # per-op rows under generic replay are served UPPER
+                # bounds; fix the root to the exact result cardinality
+                # (one sync) and say what the inner numbers are
+                if mode == "replay_gen":
+                    try:
+                        result.profile["rows"] = \
+                            result.records.table.exact_size()
+                    except Exception:
+                        pass
+                    result.profile["rows_inner"] = "upper-bound"
+
+    def metrics_snapshot(self) -> dict:
+        """Session snapshot extended with the device backend's counters
+        (communication accounting, fallbacks, size syncs) and the fused
+        executor's record/replay stats — the scattered stats the obs
+        registry absorbs (ISSUE 3 tentpole)."""
+        snap = super().metrics_snapshot()
+        be = self.backend
+        snap.update({
+            "backend.ici_bytes": be.ici_bytes,
+            "backend.ici_payload_bytes": be.ici_payload_bytes,
+            "backend.dist_joins": be.dist_joins,
+            "backend.broadcast_joins": be.broadcast_joins,
+            "backend.salted_joins": be.salted_joins,
+            "backend.fallbacks": be.fallbacks,
+            "backend.syncs": be.syncs,
+            "fused.recordings": self.fused.recordings,
+            "fused.replays": self.fused.replays,
+            "fused.generic_replays": self.fused.generic_replays,
+            "fused.mismatches": self.fused.mismatches,
+        })
+        return snap
 
     @property
     def fallback_count(self) -> int:

@@ -590,7 +590,7 @@ def run_ldbc_bench(scale: float = 11.0, on_tpu: bool = True,
     so far, honestly labelled partial.
     """
     import statistics
-    import time as _time
+    from caps_tpu.obs import clock as _clock
 
     from caps_tpu.backends.local.session import LocalCypherSession
     from caps_tpu.backends.tpu.session import TPUCypherSession
@@ -643,9 +643,9 @@ def run_ldbc_bench(scale: float = 11.0, on_tpu: bool = True,
 
     # -- timing leg (full scale, device backend) ------------------------
     session = TPUCypherSession()
-    t0 = _time.perf_counter()
+    t0 = _clock.now()
     g, d = build_graph(session, scale=scale, seed=seed)
-    build_s = _time.perf_counter() - t0
+    build_s = _clock.now() - t0
     publish(sum(parity.values()), len(parity), build_s, partial=True)
 
     for name, (q, mk) in queries.items():
@@ -657,10 +657,10 @@ def run_ldbc_bench(scale: float = 11.0, on_tpu: bool = True,
         fallbacks = 0
         # warm (compile) run
         warm_params = mk(d, rng)
-        t0 = _time.perf_counter()
+        t0 = _clock.now()
         res = g.cypher(q, warm_params)
         rows = res.records.to_maps()
-        compile_s = _time.perf_counter() - t0
+        compile_s = _clock.now() - t0
         fallbacks += (res.metrics or {}).get("device_fallbacks", 0)
         digest = _digest(rows)
         for _ in range(iters):
@@ -671,10 +671,10 @@ def run_ldbc_bench(scale: float = 11.0, on_tpu: bool = True,
             # generic fused replay the exact-row-count sync is paid in
             # to_maps, after the per-query metrics snapshot
             syncs_before = session.backend.syncs
-            t0 = _time.perf_counter()
+            t0 = _clock.now()
             res = g.cypher(q, params)
             res.records.to_maps()
-            times.append(_time.perf_counter() - t0)
+            times.append(_clock.now() - t0)
             syncs.append(session.backend.syncs - syncs_before)
             fallbacks += (res.metrics or {}).get("device_fallbacks", 0)
         if not times:

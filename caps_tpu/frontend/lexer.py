@@ -33,6 +33,12 @@ KEYWORDS = frozenset({
     "USE", "CALL", "YIELD",
 })
 
+# EXPLAIN / PROFILE are *prefix markers*, not reserved words: no valid
+# statement starts with a bare identifier, so a leading IDENT spelled
+# like one of these is unambiguous — and `explain`/`profile` stay usable
+# as variable/alias/property names everywhere else (obs/).
+QUERY_MODES = frozenset({"EXPLAIN", "PROFILE"})
+
 # Token kinds
 IDENT = "IDENT"
 KEYWORD = "KEYWORD"

@@ -15,8 +15,8 @@ from typing import List, Optional, Tuple
 
 from caps_tpu.frontend import ast
 from caps_tpu.frontend.lexer import (
-    EOF, FLOAT, IDENT, INT, KEYWORD, STRING, SYM, CypherSyntaxError, Token,
-    tokenize,
+    EOF, FLOAT, IDENT, INT, KEYWORD, QUERY_MODES, STRING, SYM,
+    CypherSyntaxError, Token, tokenize,
 )
 from caps_tpu.ir import exprs as E
 
@@ -86,6 +86,17 @@ class CypherParser:
     # -- entry points -------------------------------------------------------
 
     def parse_statement(self) -> ast.Statement:
+        # EXPLAIN / PROFILE query prefixes (observability — obs/):
+        # consumed here so `parse_query` validates prefixed text; mode
+        # DISPATCH lives solely in `query_mode`, which the session calls
+        # to strip the prefix BEFORE planning, so plan-cache and
+        # fused-executor keys never see it.  They are prefix markers,
+        # not keywords: a leading bare identifier is never valid
+        # openCypher, so consuming one here is unambiguous and the words
+        # stay usable as names/variables elsewhere.
+        t = self.peek()
+        if t.kind == IDENT and t.text.upper() in QUERY_MODES:
+            self.advance()
         if self.at_kw("CATALOG"):
             stmt = self._parse_catalog_statement()
         else:
@@ -765,6 +776,30 @@ def parse_query(query: str, memo: bool = True) -> ast.Statement:
     if memo:
         return _parse_memo(query)
     return CypherParser(query).parse_statement()
+
+
+@functools.lru_cache(maxsize=2048)
+def query_mode(query: str) -> Tuple[Optional[str], str]:
+    """Split an ``EXPLAIN`` / ``PROFILE`` prefix off a query.
+
+    Returns ``(mode, body)`` where ``mode`` is ``'explain'``,
+    ``'profile'``, or None, and ``body`` is the query text with the
+    prefix removed (byte-exact tail of the original, so downstream
+    cache keys — plan cache, fused executor — are identical to the
+    un-prefixed query's; a PROFILE run can therefore HIT the plan cache
+    entry a plain run stored, and vice versa).  Token-level detection:
+    leading comments/whitespace are handled, and unlexable text passes
+    through for the parser to report."""
+    try:
+        toks = tokenize(query)
+    except CypherSyntaxError:
+        return None, query
+    if toks and toks[0].kind == IDENT and toks[0].text.upper() in QUERY_MODES:
+        mode = toks[0].text.lower()
+        body = query[toks[1].pos:] if len(toks) > 1 and \
+            toks[1].kind != EOF else ""
+        return mode, body
+    return None, query
 
 
 @functools.lru_cache(maxsize=2048)

@@ -1,0 +1,37 @@
+"""caps_tpu observability: tracing, metrics, EXPLAIN/PROFILE plumbing.
+
+The measuring instrument for the roofline gap (ROADMAP / round-5
+verdict): structured spans (query → phase → relational operator) with
+wall time, device time, output cardinality, and bytes moved; a metrics
+registry that absorbs the engine's scattered stats; and exporters
+(JSON-lines, ``chrome://tracing``).  The Cypher ``EXPLAIN`` / ``PROFILE``
+query prefixes (frontend/parser.py, relational/session.py) are the
+user-facing entry points; ``session.metrics_snapshot()`` is the
+programmatic one.
+
+Design constraints:
+
+* near-zero overhead when disabled — a disabled tracer returns a shared
+  no-op span; per-operator instrumentation costs one attribute check;
+* never silently wrong numbers — fused-replay runs tag per-operator
+  times as host dispatch and report device time as a per-replay
+  aggregate span (docs/tpu.md);
+* one clock — all timestamps come from :mod:`caps_tpu.obs.clock`
+  (enforced by ``scripts/check_no_naked_timers.py``).
+"""
+from caps_tpu.obs import clock
+from caps_tpu.obs.export import (chrome_trace_events, write_chrome_trace,
+                                 write_jsonl)
+from caps_tpu.obs.metrics import (MetricsRegistry, diff_snapshots,
+                                  global_registry)
+from caps_tpu.obs.profile import (find_executed_rows, profile_tree,
+                                  render_profile, tag_timing)
+from caps_tpu.obs.tracer import (NULL_SPAN, NullSpan, Span, Tracer, activate,
+                                 active_tracer)
+
+__all__ = [
+    "clock", "Span", "NullSpan", "NULL_SPAN", "Tracer", "activate",
+    "active_tracer", "MetricsRegistry", "global_registry", "diff_snapshots",
+    "write_jsonl", "write_chrome_trace", "chrome_trace_events",
+    "profile_tree", "render_profile", "tag_timing", "find_executed_rows",
+]

@@ -1,0 +1,18 @@
+"""The single sanctioned time source for the engine.
+
+Every timing read inside ``caps_tpu/`` goes through this module; naked
+``time.perf_counter()`` / ``time.time()`` calls elsewhere are rejected by
+``scripts/check_no_naked_timers.py``.  Centralizing the clock keeps all
+measurements on one monotonic base (spans, per-operator metrics, and the
+chrome-trace export timestamps all compare), and gives tests a single
+seam to stub.
+"""
+from __future__ import annotations
+
+import time as _time
+
+#: Monotonic high-resolution seconds — span durations, operator timings.
+now = _time.perf_counter
+
+#: Epoch seconds — only for human-facing timestamps, never for deltas.
+wall = _time.time

@@ -138,6 +138,20 @@ class EngineConfig:
     # result digests; raises NondeterministicResultError on mismatch.
     determinism_check: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_DETERMINISM_CHECK", False))
+    # Observability (caps_tpu/obs/): ambient tracing for EVERY query.
+    # Off by default — the disabled tracer costs one attribute check per
+    # instrumented site (<5% overhead budget); PROFILE force-enables it
+    # for its one query regardless of this flag.
+    trace: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_TRACE", False))
+    # PROFILE granularity: sync the device after each operator so per-op
+    # spans carry real device time (post-block_until_ready deltas).  Off,
+    # the dispatch stream stays async (what steady-state fused replay
+    # actually runs) and the TPU session reports device time as ONE
+    # per-replay aggregate span — per-op numbers are then host dispatch
+    # times and are labeled as such, never silently wrong (docs/tpu.md).
+    profile_sync_each_op: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_PROFILE_SYNC", True))
 
     def bucket_for(self, n: int) -> int:
         for b in self.bucket_sizes:

@@ -18,6 +18,35 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from caps_tpu.obs import active_tracer, global_registry
+
+
+def note_collective(op: str, *arrays, scale: int = 1, **attrs) -> None:
+    """Observability hook for collective launches (obs/ — ISSUE 3).
+
+    These wrappers execute at TRACE time (once per XLA compile of the
+    enclosing shard_map program), not per device execution, so counts
+    and byte totals are per-compile — recorded under
+    ``collectives.<op>.*`` in the process-global registry and as
+    ``when="trace"`` tracer events, never mislabeled as per-run wire
+    traffic.  ``scale`` multiplies the byte estimate when the traced
+    launch runs more than once per compile (a ring rotation inside a
+    fori_loop body traces once but fires n_shards times).  The
+    per-execution wire/payload accounting stays with the callers that
+    know the run context (backends/tpu/table.py dist joins, which emit
+    their own ``dist_join.*`` events)."""
+    try:
+        nbytes = scale * sum(int(a.size) * a.dtype.itemsize for a in arrays)
+    except Exception:  # abstract avals without sizes: count the call only
+        nbytes = 0
+    reg = global_registry()
+    reg.counter(f"collectives.{op}.calls").inc()
+    reg.counter(f"collectives.{op}.traced_bytes").inc(nbytes)
+    tr = active_tracer()
+    if tr.enabled:
+        tr.event(f"collective.{op}", kind="collective", bytes=nbytes,
+                 when="trace", **attrs)
+
 
 def shard_of(key: jnp.ndarray, n_shards: int) -> jnp.ndarray:
     """Destination shard for a join/group key (dense ids: range partition
@@ -67,6 +96,7 @@ def exchange_binned(arr: jnp.ndarray, dest: jnp.ndarray,
     binned = jnp.full((n_shards, bin_cap) + arr.shape[1:], fill, arr.dtype)
     binned = binned.at[dest, jnp.clip(row_pos, 0, bin_cap - 1)].set(
         arr, mode="drop")
+    note_collective("all_to_all", binned)
     return lax.all_to_all(binned, axis, split_axis=0, concat_axis=0,
                           tiled=False)
 
@@ -90,14 +120,17 @@ def ring_shift(x: jnp.ndarray, axis: str, n_shards: int,
     communication pattern of ring attention, applied to frontier blocks in
     multi-hop expansion (SURVEY.md §5.7)."""
     perm = [(i, (i + offset) % n_shards) for i in range(n_shards)]
+    note_collective("ppermute", x)
     return lax.ppermute(x, axis, perm)
 
 
 def broadcast_concat(x: jnp.ndarray, axis: str) -> jnp.ndarray:
     """all_gather a small table side to every device (broadcast-hash join
     analog of Spark's TorrentBroadcast)."""
+    note_collective("all_gather", x)
     return lax.all_gather(x, axis, tiled=True)
 
 
 def global_sum(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    note_collective("psum", x)
     return lax.psum(x, axis)

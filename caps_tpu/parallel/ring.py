@@ -23,6 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from caps_tpu.parallel.collectives import note_collective
 from caps_tpu.parallel.compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -33,6 +34,10 @@ def _ring_hop(cnt_block, edge_src, edge_dst, edge_ok, *, axis: str,
     nb = n_nodes // n_shards
     my = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    # trace-time accounting (obs/): the fori body traces ONCE but the
+    # rotation runs n_shards times per hop — scale the byte estimate
+    note_collective("ring.ppermute", cnt_block, scale=n_shards,
+                    rotations=n_shards)
 
     def body(t, carry):
         blk, acc = carry
@@ -52,6 +57,7 @@ def _ring_hop(cnt_block, edge_src, edge_dst, edge_ok, *, axis: str,
     local_out = jax.ops.segment_sum(per_edge, edge_dst,
                                     num_segments=n_nodes)
     # psum + scatter back to node blocks in one collective
+    note_collective("ring.psum_scatter", local_out)
     return jax.lax.psum_scatter(local_out, axis, scatter_dimension=0,
                                 tiled=True)
 
@@ -125,6 +131,8 @@ def _ring_hop_matrix(f_block, edge_src, edge_dst, edge_ok, *, axis: str,
     n_seeds = f_block.shape[0]
     my = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    note_collective("ring.ppermute", f_block, scale=n_shards,
+                    rotations=n_shards)
 
     def body(t, carry):
         blk, acc = carry  # blk: (S, nb); acc: (S, E_local)
@@ -145,6 +153,7 @@ def _ring_hop_matrix(f_block, edge_src, edge_dst, edge_ok, *, axis: str,
     _, per_edge = jax.lax.fori_loop(0, n_shards, body, (f_block, acc0))
     local_out = jax.ops.segment_sum(per_edge.T, edge_dst,
                                     num_segments=n_nodes)  # (N, S)
+    note_collective("ring.psum_scatter", local_out)
     out = jax.lax.psum_scatter(local_out, axis, scatter_dimension=0,
                                tiled=True)  # (nb, S)
     return out.T

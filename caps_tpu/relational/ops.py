@@ -11,9 +11,10 @@ from __future__ import annotations
 
 import abc
 import dataclasses
-import time
 from contextlib import nullcontext
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from caps_tpu.obs import clock
 
 try:  # profiling is optional — this layer stays backend-agnostic
     from jax.profiler import TraceAnnotation as _TraceAnnotation
@@ -81,6 +82,9 @@ class RelationalRuntimeContext:
         # per-operator wall-clock + row counts, filled as ops evaluate
         # (SURVEY.md §5.1 — the structured analog of the Spark UI stage view)
         self.op_metrics: List[Dict[str, Any]] = []
+        # the session tracer, cached so the per-operator hot path pays
+        # one attribute read (None for bare/mock sessions in tests)
+        self.tracer = getattr(session, "tracer", None)
 
     def rebind(self, parameters: Mapping[str, Any]) -> None:
         """Swap in fresh parameter bindings for a cached-plan
@@ -190,11 +194,25 @@ class RelationalOperator(abc.ABC):
     def result(self) -> Tuple[RecordHeader, Table]:
         if self._result is None:
             name = type(self).__name__.removesuffix("Op")
-            t0 = time.perf_counter()
-            span = (_TraceAnnotation(f"caps_tpu.{name}")
-                    if _TraceAnnotation is not None else nullcontext())
-            with span:
-                self._result = self._compute()
+            tracer = self.context.tracer
+            tr_span = (tracer.span(f"op.{name}", kind="operator")
+                       if tracer is not None and tracer.enabled
+                       else nullcontext())
+            t0 = clock.now()
+            device_s: Optional[float] = None
+            with tr_span as sp:
+                xla_span = (_TraceAnnotation(f"caps_tpu.{name}")
+                            if _TraceAnnotation is not None else nullcontext())
+                with xla_span:
+                    self._result = self._compute()
+                if tracer is not None and tracer.enabled \
+                        and tracer.sync_device:
+                    # PROFILE per-op device mode: wait for the dispatched
+                    # work so this span's wall time is the real
+                    # post-block_until_ready delta, then record the
+                    # device-inclusive duration explicitly
+                    self._result[1].device_sync()
+                    device_s = clock.now() - t0
             try:  # bytes pulled through memory by this operator: the
                 # roofline numerator (SURVEY.md §5.5).  Only children the
                 # op actually evaluated count — summing `c.table` blindly
@@ -210,13 +228,35 @@ class RelationalOperator(abc.ABC):
                     bytes_in = self._result[1].nbytes
             except Exception:  # pragma: no cover — accounting must not fail
                 bytes_in = 0
-            self.context.op_metrics.append({
+            if device_s is not None:
+                # PROFILE per-op mode: exact cardinality, not a served
+                # bound (free in eager/exact-replay mode; one counted
+                # sync per op under generic replay — a diagnostic run
+                # may pay for honest numbers, never report wrong ones)
+                try:
+                    rows = self._result[1].exact_size()
+                except Exception:
+                    rows = self._result[1].size
+            else:
+                rows = self._result[1].size
+            entry = {
                 "op": name,
-                "seconds": time.perf_counter() - t0,
-                "rows": self._result[1].size,
+                "seconds": clock.now() - t0,
+                "rows": rows,
                 "bytes_in": bytes_in,
                 **getattr(self, "_metric_extra", {}),
-            })
+            }
+            if device_s is not None:
+                entry["device_s"] = device_s
+            self.context.op_metrics.append(entry)
+            # run-stamped measurement for PROFILE (obs/profile.py): the
+            # op_metrics LIST identity tags which run the entry belongs
+            # to — rebind() swaps in a fresh list, so stale stamps from
+            # an earlier cached-plan execution are detectable.
+            self._last_metrics = (self.context.op_metrics, entry)
+            if sp is not None:  # nullcontext (tracing disabled) yields None
+                sp.annotate(rows=entry["rows"], bytes=bytes_in,
+                            device_s=device_s)
         return self._result
 
     @property

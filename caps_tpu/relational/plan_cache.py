@@ -250,18 +250,52 @@ class PlanCache:
     Keyed by (normalized query text, graph plan token, catalog
     fingerprint, parameter signature); each key holds the (usually one)
     plans that differ only in recorded value specializations.  LRU order
-    and the size cap count individual plans."""
+    and the size cap count individual plans.
 
-    def __init__(self, max_size: int = 256, enabled: bool = True):
+    Counters live in a :class:`caps_tpu.obs.metrics.MetricsRegistry`
+    (the session passes its own), so ``plan_cache.*`` shows up in
+    ``session.metrics_snapshot()`` alongside every other stat and
+    consumers (bench.py) diff snapshots instead of hand-rolling
+    before/after counters.  ``stats()`` and the attribute accessors
+    (``.hits`` etc.) read the same counters — one source of truth."""
+
+    def __init__(self, max_size: int = 256, enabled: bool = True,
+                 registry=None):
+        from caps_tpu.obs.metrics import MetricsRegistry
         self.max_size = max(1, int(max_size))
         self.enabled = enabled
         self._entries: "OrderedDict[Tuple, List[CachedPlan]]" = OrderedDict()
         self._count = 0
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.invalidations = 0
-        self.saved_s = 0.0          # cold-phase seconds skipped by hits
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        self._hits = self.metrics.counter("plan_cache.hits")
+        self._misses = self.metrics.counter("plan_cache.misses")
+        self._evictions = self.metrics.counter("plan_cache.evictions")
+        # catalog-fingerprint evictions (CATALOG CREATE/DROP etc.)
+        self._invalidations = self.metrics.counter("plan_cache.invalidations")
+        # cold-phase seconds skipped by hits
+        self._saved_s = self.metrics.counter("plan_cache.saved_s")
+        self.metrics.gauge("plan_cache.entries", fn=lambda: self._count)
+
+    # attribute-style reads kept for existing callers/tests
+    @property
+    def hits(self) -> int:
+        return self._hits.value
+
+    @property
+    def misses(self) -> int:
+        return self._misses.value
+
+    @property
+    def evictions(self) -> int:
+        return self._evictions.value
+
+    @property
+    def invalidations(self) -> int:
+        return self._invalidations.value
+
+    @property
+    def saved_s(self) -> float:
+        return self._saved_s.value
 
     def lookup(self, key: Tuple,
                params: Mapping[str, Any]) -> Optional[CachedPlan]:
@@ -275,10 +309,10 @@ class PlanCache:
                         plan.spec_key, params) == plan.spec_key
                 if match:
                     self._entries.move_to_end(key)
-                    self.hits += 1
-                    self.saved_s += plan.cold_phase_s
+                    self._hits.inc()
+                    self._saved_s.inc(plan.cold_phase_s)
                     return plan
-        self.misses += 1
+        self._misses.inc()
         return None
 
     def store(self, key: Tuple, plan: CachedPlan) -> None:
@@ -296,7 +330,7 @@ class PlanCache:
         while self._count > self.max_size and self._entries:
             _, dropped = self._entries.popitem(last=False)
             self._count -= len(dropped)
-            self.evictions += len(dropped)
+            self._evictions.inc(len(dropped))
 
     def evict_stale(self, catalog_version: int) -> int:
         """Explicit invalidation: drop every entry planned under an older
@@ -306,7 +340,7 @@ class PlanCache:
         stale = [k for k in self._entries if k[2] != catalog_version]
         for k in stale:
             self._count -= len(self._entries.pop(k))
-            self.invalidations += 1
+            self._invalidations.inc()
         return len(stale)
 
     def clear(self) -> None:

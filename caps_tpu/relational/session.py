@@ -8,14 +8,16 @@ SURVEY.md §2, §3.1).
 from __future__ import annotations
 
 import abc
+import contextlib
 import hashlib
 import logging
-import time
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 logger = logging.getLogger("caps_tpu")
 
-from caps_tpu.frontend.parser import normalize_query, parse_query
+from caps_tpu import obs
+from caps_tpu.obs import clock
+from caps_tpu.frontend.parser import normalize_query, parse_query, query_mode
 from caps_tpu.ir import blocks as B
 from caps_tpu.ir import exprs as E
 from caps_tpu.ir.builder import IRBuilder
@@ -240,6 +242,9 @@ class RelationalCypherResult(CypherResult):
         self._graph = graph
         self.plans = plans or {}
         self.metrics = metrics or {}
+        # PROFILE annotation (obs/profile.py): plain-dict operator tree
+        # with per-node rows/seconds/bytes; None unless profiled.
+        self.profile: Optional[Dict[str, Any]] = None
 
     @property
     def records(self) -> Optional[RelationalCypherRecords]:
@@ -254,7 +259,7 @@ class RelationalCypherResult(CypherResult):
 
     def explain(self) -> str:
         parts = []
-        for phase in ("ir", "logical", "relational"):
+        for phase in ("ir", "logical", "relational", "profile"):
             if phase in self.plans:
                 parts.append(f"=== {phase.upper()} ===\n{self.plans[phase]}")
         return "\n\n".join(parts)
@@ -267,10 +272,19 @@ class RelationalCypherSession(CypherSession):
         self._catalog = CypherCatalog()
         self.config = config or DEFAULT_CONFIG
         self._ambient = EmptyGraph(self)
+        # Observability (caps_tpu/obs/): the session tracer collects
+        # query → phase → operator spans; the registry absorbs the
+        # session's counters (plan cache, per-phase histograms) behind
+        # metrics_snapshot().  Tracing is off unless config.trace or a
+        # PROFILE query force-enables it.
+        self.metrics_registry = obs.MetricsRegistry()
+        self.tracer = obs.Tracer(enabled=self.config.trace)
+        self._profiling = False
         # Prepared-statement plan cache (relational/plan_cache.py): keyed
         # value-independently; catalog mutations evict dependent entries.
         self.plan_cache = PlanCache(self.config.plan_cache_size,
-                                    enabled=self.config.use_plan_cache)
+                                    enabled=self.config.use_plan_cache,
+                                    registry=self.metrics_registry)
         self._catalog.subscribe(self.plan_cache.evict_stale)
 
     # -- backend SPI --------------------------------------------------------
@@ -301,7 +315,17 @@ class RelationalCypherSession(CypherSession):
     def cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
                         parameters: Optional[Mapping[str, Any]] = None
                         ) -> CypherResult:
-        result = self._cypher_on_graph(graph, query, parameters)
+        # EXPLAIN / PROFILE prefixes strip HERE, before any cache key is
+        # formed — a PROFILE run hits the same plan-cache / fused-memo
+        # entries as the plain query (and vice versa), never a poisoned
+        # key.
+        mode, body = query_mode(query)
+        if mode == "explain":
+            return self._explain_on_graph(graph, body, parameters)
+        if mode == "profile":
+            return self._profile_on_graph(graph, body, parameters)
+        with self._observed():
+            result = self._cypher_on_graph(graph, query, parameters)
         if self.config.determinism_check and result.records is not None:
             # SURVEY.md §5.2: deterministic replay — run the same query a
             # second time and compare multiset digests of the results.
@@ -315,6 +339,128 @@ class RelationalCypherSession(CypherSession):
             result.metrics["determinism_digest"] = d1
         return result
 
+    def _plan_ir(self, graph: RelationalCypherGraph, ir,
+                 plan_params, params: Dict[str, Any]):
+        """Logical planning + optimization + relational planning for one
+        (non-catalog) IR statement.  The ONE planning pipeline shared by
+        the execute path, EXPLAIN, and CATALOG CREATE GRAPH — so the
+        plan EXPLAIN renders is by construction the plan that executes.
+        Returns (logical, context, rel_planner, root, t_logical_done)."""
+        with self.tracer.span("logical", kind="phase"):
+            logical = LogicalPlanner(graph.schema, self._schema_resolver,
+                                     plan_params).process(ir)
+            logical = LogicalOptimizer().process(logical)
+        t3 = clock.now()
+        with self.tracer.span("relational", kind="phase"):
+            context = R.RelationalRuntimeContext(self, params)
+            rel_planner = RelationalPlanner(context, graph,
+                                            self._graph_resolver)
+            root = rel_planner.process(logical)
+        return logical, context, rel_planner, root, t3
+
+    @contextlib.contextmanager
+    def _observed(self):
+        """Activate this session's tracer for the duration of a query so
+        session-less instrumentation (collectives, the device backend's
+        join accounting) lands in it.  With tracing disabled the only
+        cost is one enabled check."""
+        if not self.tracer.enabled:
+            yield
+            return
+        with obs.activate(self.tracer):
+            yield
+
+    # -- EXPLAIN / PROFILE ---------------------------------------------------
+
+    def _explain_on_graph(self, graph: RelationalCypherGraph, query: str,
+                          parameters: Optional[Mapping[str, Any]] = None
+                          ) -> CypherResult:
+        """``EXPLAIN <query>``: run the full planning frontend and return
+        the rendered plan trees WITHOUT executing anything — no operator
+        ever computes, no catalog mutation applies (EXPLAIN of CATALOG
+        CREATE/DROP GRAPH plans the inner query but stores/drops
+        nothing)."""
+        t0 = clock.now()
+        params = dict(parameters or {})
+        plan_params = PlanParams(params)
+        with self._observed(), self.tracer.span("explain", kind="query",
+                                                query=query):
+            stmt = parse_query(query)
+            ir = IRBuilder(graph.schema, self._schema_resolver,
+                           plan_params).process(stmt)
+            plans: Dict[str, str] = {}
+            pretty = getattr(ir, "pretty", None)
+            if pretty is not None:
+                plans["ir"] = pretty()
+            if isinstance(ir, B.DropGraphStatement):
+                plans.setdefault("ir", f"DropGraph({ir.qgn})")
+                metrics = {"mode": "explain", "plan_s": clock.now() - t0,
+                           "rows": 0}
+                return RelationalCypherResult(plans=plans, metrics=metrics)
+            inner = ir.inner if isinstance(ir, B.CreateGraphStatement) else ir
+            logical, _context, _planner, root, _t3 = self._plan_ir(
+                graph, inner, plan_params, params)
+            plans["logical"] = logical.pretty()
+            plans["relational"] = root.pretty()
+        metrics = {"mode": "explain", "plan_s": clock.now() - t0, "rows": 0}
+        return RelationalCypherResult(plans=plans, metrics=metrics)
+
+    def _profile_on_graph(self, graph: RelationalCypherGraph, query: str,
+                          parameters: Optional[Mapping[str, Any]] = None
+                          ) -> CypherResult:
+        """``PROFILE <query>``: execute with the tracer force-enabled and
+        annotate every relational operator with its measured span
+        (rows / wall time / bytes; device time when per-op sync is on —
+        config.profile_sync_each_op)."""
+        prev_profiling = self._profiling
+        self._profiling = True
+        try:
+            with self.tracer.forced(
+                    sync_device=self.config.profile_sync_each_op):
+                with obs.activate(self.tracer):
+                    with self.tracer.span("query", kind="query",
+                                          query=query, mode="profile"):
+                        result = self._cypher_on_graph(graph, query,
+                                                       parameters)
+        finally:
+            self._profiling = prev_profiling
+        if result.metrics is not None:
+            result.metrics["mode"] = "profile"
+        if result.profile is not None:
+            # copy-on-write: the plans dict may be SHARED with a cached
+            # plan entry — annotating in place would leak profile text
+            # into later non-profile results served from the cache
+            result.plans = dict(result.plans)
+            result.plans["profile"] = obs.render_profile(result.profile)
+        return result
+
+    # -- metrics / trace export ----------------------------------------------
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """One flat dict of every session-level stat: the metrics
+        registry (plan-cache counters, per-phase histograms) plus
+        derived plan-cache numbers.  Backends extend this with their
+        device counters.  Consumers measure intervals with
+        ``obs.diff_snapshots(before, after)``."""
+        snap = self.metrics_registry.snapshot()
+        for k, v in self.plan_cache.stats().items():
+            snap[f"plan_cache.{k}"] = v
+        snap["tracer.spans"] = len(self.tracer.spans)
+        snap["tracer.dropped"] = self.tracer.dropped
+        return snap
+
+    def export_trace(self, path: str, fmt: str = "chrome") -> str:
+        """Dump the tracer's collected spans: ``fmt='chrome'`` writes a
+        ``chrome://tracing``-loadable file, ``fmt='jsonl'`` one JSON
+        object per span."""
+        if fmt == "chrome":
+            obs.write_chrome_trace(self.tracer.spans, path)
+        elif fmt == "jsonl":
+            obs.write_jsonl(self.tracer.spans, path)
+        else:
+            raise ValueError(f"unknown trace format {fmt!r}")
+        return path
+
     def _plan_cache_key(self, graph: RelationalCypherGraph, query: str,
                         params: Mapping[str, Any]) -> Optional[Tuple]:
         gtok = graph_plan_token(graph)
@@ -326,8 +472,9 @@ class RelationalCypherSession(CypherSession):
     def _cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
                          parameters: Optional[Mapping[str, Any]] = None
                          ) -> CypherResult:
-        t0 = time.perf_counter()
+        t0 = clock.now()
         params = dict(parameters or {})
+        tracer = self.tracer
 
         cache_key: Optional[Tuple] = None
         if self.plan_cache.enabled:
@@ -342,12 +489,14 @@ class RelationalCypherSession(CypherSession):
         # read as a cache specialization; runtime parameter reads go
         # through the context's plain dict and stay free.
         plan_params = PlanParams(params)
-        stmt = parse_query(query)
+        with tracer.span("parse", kind="phase"):
+            stmt = parse_query(query)
 
-        t1 = time.perf_counter()
-        ir = IRBuilder(graph.schema, self._schema_resolver,
-                       plan_params).process(stmt)
-        t2 = time.perf_counter()
+        t1 = clock.now()
+        with tracer.span("ir", kind="phase"):
+            ir = IRBuilder(graph.schema, self._schema_resolver,
+                           plan_params).process(stmt)
+        t2 = clock.now()
 
         if isinstance(ir, B.CreateGraphStatement):
             return self._run_create_graph(graph, ir, params)
@@ -355,15 +504,9 @@ class RelationalCypherSession(CypherSession):
             self._catalog.delete(ir.qgn)
             return RelationalCypherResult()
 
-        logical = LogicalPlanner(graph.schema, self._schema_resolver,
-                                 plan_params).process(ir)
-        logical = LogicalOptimizer().process(logical)
-        t3 = time.perf_counter()
-
-        context = R.RelationalRuntimeContext(self, params)
-        rel_planner = RelationalPlanner(context, graph, self._graph_resolver)
-        root = rel_planner.process(logical)
-        t4 = time.perf_counter()
+        logical, context, rel_planner, root, t3 = self._plan_ir(
+            graph, ir, plan_params, params)
+        t4 = clock.now()
 
         plans = {"ir": ir.pretty(), "logical": logical.pretty(),
                  "relational": root.pretty()}
@@ -376,14 +519,15 @@ class RelationalCypherSession(CypherSession):
 
         result_graph: Optional[RelationalCypherGraph] = None
         records: Optional[RelationalCypherRecords] = None
-        if logical.returns_graph:
-            result_graph = self._evaluate_graph(root)
-        else:
-            header, table = root.result
-            records = RelationalCypherRecords(
-                self, header, table, logical.result_fields,
-                graph=rel_planner.current_graph)
-        t5 = time.perf_counter()
+        with tracer.span("execute", kind="phase"):
+            if logical.returns_graph:
+                result_graph = self._evaluate_graph(root)
+            else:
+                header, table = root.result
+                records = RelationalCypherRecords(
+                    self, header, table, logical.result_fields,
+                    graph=rel_planner.current_graph)
+        t5 = clock.now()
 
         metrics = {
             "parse_s": t1 - t0, "ir_s": t2 - t1, "plan_s": t3 - t2,
@@ -402,6 +546,14 @@ class RelationalCypherSession(CypherSession):
             print(f"[caps-tpu] timings: {metrics}")
         logger.debug("query %r: %d rows in %.1f ms", query,
                      metrics["rows"], 1e3 * (t5 - t0))
+        self.metrics_registry.observe("query.plan_s", t4 - t0)
+        self.metrics_registry.observe("query.execute_s", t5 - t4)
+        if self._profiling:
+            # snapshot per-operator measurements into plain dicts BEFORE
+            # the cache store resets the tree (obs/profile.py)
+            result_profile = obs.profile_tree(root, context)
+        else:
+            result_profile = None
 
         if (cache_key is not None and records is not None
                 and not logical.returns_graph and plan_params.cacheable):
@@ -415,7 +567,9 @@ class RelationalCypherSession(CypherSession):
             # so a cached plan retains no tables between executions.
             reset_plan(root)
             self.plan_cache.store(cache_key, entry)
-        return RelationalCypherResult(records, result_graph, plans, metrics)
+        result = RelationalCypherResult(records, result_graph, plans, metrics)
+        result.profile = result_profile
+        return result
 
     def _run_cached(self, plan: CachedPlan, query: str,
                     params: Dict[str, Any], t0: float) -> CypherResult:
@@ -427,11 +581,13 @@ class RelationalCypherSession(CypherSession):
         context = plan.context
         context.rebind(params)
         reset_plan(plan.root)
-        t1 = time.perf_counter()
-        header, table = plan.root.result
-        records = RelationalCypherRecords(
-            self, header, table, plan.result_fields, graph=plan.records_graph)
-        t2 = time.perf_counter()
+        t1 = clock.now()
+        with self.tracer.span("execute", kind="phase", plan_cache="hit"):
+            header, table = plan.root.result
+            records = RelationalCypherRecords(
+                self, header, table, plan.result_fields,
+                graph=plan.records_graph)
+        t2 = clock.now()
         if self.config.print_ir:
             print(plan.plans["ir"])
         if self.config.print_logical_plan:
@@ -449,6 +605,8 @@ class RelationalCypherSession(CypherSession):
             "plan_cache": "hit",
             "plan_cache_saved_s": plan.cold_phase_s,
         }
+        result_profile = (obs.profile_tree(plan.root, context)
+                          if self._profiling else None)
         # the records object owns (header, table) now; the parked tree
         # must not pin device buffers until its next execution
         reset_plan(plan.root)
@@ -456,20 +614,18 @@ class RelationalCypherSession(CypherSession):
             print(f"[caps-tpu] timings: {metrics}")
         logger.debug("query %r: %d rows in %.1f ms (plan cache hit)",
                      query, metrics["rows"], 1e3 * (t2 - t0))
-        return RelationalCypherResult(records, None, plan.plans, metrics)
+        self.metrics_registry.observe("query.execute_s", t2 - t1)
+        result = RelationalCypherResult(records, None, plan.plans, metrics)
+        result.profile = result_profile
+        return result
 
     # -- graph-returning statements -----------------------------------------
 
     def _run_create_graph(self, graph, ir: B.CreateGraphStatement, params):
         """CATALOG CREATE GRAPH qgn { inner }: evaluate the inner query's
         graph and store it under the qualified name."""
-        inner = ir.inner
-        logical = LogicalPlanner(graph.schema, self._schema_resolver,
-                                 params).process(inner)
-        logical = LogicalOptimizer().process(logical)
-        context = R.RelationalRuntimeContext(self, params)
-        planner = RelationalPlanner(context, graph, self._graph_resolver)
-        root = planner.process(logical)
+        logical, context, planner, root, _t3 = self._plan_ir(
+            graph, ir.inner, params, params)
         if not logical.returns_graph:
             raise ValueError(
                 "CATALOG CREATE GRAPH requires the inner query to end with "

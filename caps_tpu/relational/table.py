@@ -185,6 +185,13 @@ class Table(abc.ABC):
     def cache(self) -> "Table":
         return self
 
+    def device_sync(self) -> None:
+        """Wait for any in-flight device work producing this table
+        (PROFILE's per-operator device-time mode — obs/).  Host-side
+        backends are synchronous already: no-op.  Never transfers data
+        or consumes fused-replay sizes — purely a completion barrier."""
+        return None
+
 
 class TableFactory(abc.ABC):
     """Backend-side constructors for tables."""

@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Lint: no naked timers inside ``caps_tpu/``.
+
+All timing reads must go through ``caps_tpu.obs.clock`` (the single
+monotonic base every span, operator metric, and trace export shares —
+ISSUE 3 satellite).  This script greps ``caps_tpu/`` for
+``time.perf_counter(`` / ``time.time(`` calls outside ``caps_tpu/obs/``
+(aliased imports like ``import time as _time`` are caught too: the
+pattern matches the attribute access, not the import name binding).
+
+Exit status: 0 clean, 1 with findings (one ``path:line: text`` per
+offence).  Run standalone or via the CI workflow.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "caps_tpu")
+EXEMPT = os.path.join(PKG, "obs") + os.sep
+
+# matches `time.perf_counter(` / `time.time(` including aliased modules
+# (`_time.perf_counter(`) — any attribute access ending in these names
+PATTERN = re.compile(r"time\.(?:perf_counter|time)\s*\(")
+
+
+def findings():
+    out = []
+    for root, _dirs, files in os.walk(PKG):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            if path.startswith(EXEMPT):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if PATTERN.search(line):
+                        rel = os.path.relpath(path, REPO)
+                        out.append(f"{rel}:{lineno}: {line.strip()}")
+    return out
+
+
+def main() -> int:
+    bad = findings()
+    if bad:
+        print("naked timers found (use caps_tpu.obs.clock instead):")
+        for b in bad:
+            print(f"  {b}")
+        return 1
+    print("check_no_naked_timers: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,3 +54,16 @@ def test_parameterized_reads(backend):
     # (min_age, row count, size_syncs) per rotation of the prepared query
     assert [(m, n) for m, n, _ in out] == [
         (30, 4), (40, 2), (25, 5), (50, 1), (30, 4)]
+
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_profile_query(backend):
+    import profile_query
+    rows, explained, profiled, n_events = profile_query.main(backend)
+    assert rows == [{"person": "Ana", "knows": "Bo"},
+                    {"person": "Ana", "knows": "Cleo"},
+                    {"person": "Bo", "knows": "Cleo"}]
+    assert explained.records is None
+    assert profiled.profile["rows"] == len(rows)
+    assert "rows=" in profiled.plans["profile"]
+    assert n_events > 0
