@@ -26,6 +26,9 @@ Capture robustness (round-2 hardening):
 Modes: ``python bench.py``           config 1 (2-hop foaf)
        ``python bench.py triangle``  config 4 (RMAT triangle count)
        ``python bench.py ldbc``      configs 2-3 (LDBC IS/IC p50/p95)
+       ``python bench.py serve``     config 5 (QueryServer load: closed-
+                                     and open-loop, latency percentiles,
+                                     batch and shed behavior)
 """
 from __future__ import annotations
 
@@ -389,6 +392,166 @@ def run_ldbc_config(on_tpu: bool):
     _emit()
 
 
+def _percentiles(samples):
+    if not samples:
+        return {}
+    xs = sorted(samples)
+    pick = lambda q: xs[min(len(xs) - 1, int(q * len(xs)))]
+    return {"p50_s": round(pick(0.50), 5), "p95_s": round(pick(0.95), 5),
+            "p99_s": round(pick(0.99), 5)}
+
+
+def run_serve_config(on_tpu: bool):
+    """Benchmark config 5: the serving tier (caps_tpu/serve/) under load.
+
+    One prepared parameterized query, rotating $seed bindings:
+
+    * closed loop — C client threads, each submit→wait→repeat: the
+      sustainable throughput number (``value``, queries/s) plus
+      p50/p95/p99 client latency;
+    * open loop — Poisson arrivals at ~2x the closed-loop rate against
+      a small queue: queue depth, micro-batch coalescing, and the
+      admission controller's shed rate under genuine overload.
+
+    vs_baseline = served throughput over single-threaded sequential
+    ``PreparedQuery.run`` on the same session (the pre-serving path).
+    """
+    import threading as _th
+    import numpy as np
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.obs import diff_snapshots
+    from caps_tpu.serve import Overloaded, QueryServer, ServerConfig
+
+    _result.update({"metric": "serve QPS (no measurement completed)",
+                    "unit": "queries/s"})
+    rng = np.random.RandomState(42)
+    if on_tpu:
+        n_people, n_edges, n_seeds = 100_000, 500_000, 20
+    else:
+        n_people, n_edges, n_seeds = 10_000, 50_000, 10
+    n_people = int(os.environ.get("BENCH_N_PEOPLE", n_people))
+    n_edges = int(os.environ.get("BENCH_N_EDGES", n_edges))
+    session = TPUCypherSession()
+    graph, src, dst, names = build_graph(session, n_people, n_edges,
+                                         n_seeds, rng)
+    seen, seeds = set(), []
+    for nm in names:
+        if nm not in seen:
+            seen.add(nm)
+            seeds.append(nm)
+        if len(seeds) == 4:
+            break
+    if "Alice" not in seeds:
+        seeds[0] = "Alice"
+    exp = expected_paths(src, dst, names, seeds)
+    prep = session.prepare(PARAM_QUERY, graph=graph)
+    t0 = time.perf_counter()
+    for s_ in seeds:  # warm: plan cache + fused recordings per seed
+        assert prep.run({"seed": s_}).records.to_maps()[0]["c"] == exp[s_]
+    compile_s = time.perf_counter() - t0
+    _result["compile_s"] = round(compile_s, 2)
+
+    # Sequential baseline: single caller, prepared path (what serving
+    # replaces).  Small count — it only anchors vs_baseline.
+    seq_n = 30
+    t0 = time.perf_counter()
+    for j in range(seq_n):
+        seed = seeds[j % len(seeds)]
+        rows = prep.run({"seed": seed}).records.to_maps()
+        assert rows[0]["c"] == exp[seed]
+    seq_qps = seq_n / (time.perf_counter() - t0)
+
+    # -- closed loop ---------------------------------------------------
+    clients = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    per_client = int(os.environ.get("BENCH_SERVE_REQS", "40"))
+    server = QueryServer(session, graph=graph, config=ServerConfig(
+        workers=2, max_queue=256, max_batch=16, batch_window_s=0.001))
+    latencies, errors = [], []
+
+    def client(i):
+        try:
+            for j in range(per_client):
+                seed = seeds[(i + j) % len(seeds)]
+                h = server.submit(PARAM_QUERY, {"seed": seed})
+                rows = h.rows()
+                assert rows[0]["c"] == exp[seed]
+                latencies.append(h.info["latency_s"])
+        except Exception as ex:  # surfaced in the metric label
+            errors.append(repr(ex))
+
+    snap0 = session.metrics_snapshot()
+    threads = [_th.Thread(target=client, args=(i,)) for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    closed_s = time.perf_counter() - t0
+    closed = diff_snapshots(snap0, session.metrics_snapshot())
+    closed_qps = len(latencies) / closed_s if closed_s else 0.0
+    _result.update({
+        "metric": f"serve QPS, closed-loop {clients} clients x "
+                  f"{per_client} reqs, 2-hop foaf $seed "
+                  f"({n_people} nodes, {n_edges} edges, "
+                  f"{'tpu' if on_tpu else 'cpu-fallback'}"
+                  + (f", errors={len(errors)}" if errors else "") + ")",
+        "value": round(closed_qps, 1),
+        "vs_baseline": round(closed_qps / seq_qps, 3) if seq_qps else 0.0,
+        "sequential_qps": round(seq_qps, 1),
+        "closed_loop_batch_mean": round(
+            closed.get("serve.batch_size.sum", 0)
+            / max(1, closed.get("serve.batch_size.count", 1)), 3),
+        "closed_loop_batch_max": closed.get("serve.batch_size.max", 0),
+        **_percentiles(latencies),
+    })
+
+    # -- open loop: Poisson arrivals over capacity ---------------------
+    if _remaining() > 15:
+        small = QueryServer(session, graph=graph, config=ServerConfig(
+            workers=2, max_queue=32, max_batch=16, batch_window_s=0.001))
+        rate = max(50.0, 2.0 * closed_qps)
+        duration = min(3.0, max(1.0, _remaining() - 10))
+        handles, shed, depth_samples = [], 0, []
+        snap1 = session.metrics_snapshot()
+        t0 = time.perf_counter()
+        next_t = t0
+        k = 0
+        while time.perf_counter() - t0 < duration:
+            next_t += rng.exponential(1.0 / rate)
+            lag = next_t - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                handles.append(small.submit(
+                    PARAM_QUERY, {"seed": seeds[k % len(seeds)]}))
+            except Overloaded:
+                shed += 1
+            k += 1
+            if k % 8 == 0:
+                depth_samples.append(small.admission.depth())
+        for h in handles:
+            h.wait(timeout=30)
+        small.shutdown()
+        open_delta = diff_snapshots(snap1, session.metrics_snapshot())
+        total = len(handles) + shed
+        _result.update({
+            "open_loop_rate_qps": round(rate, 1),
+            "open_loop_shed_rate": round(shed / total, 4) if total else 0.0,
+            "open_loop_queue_depth_mean": round(
+                sum(depth_samples) / len(depth_samples), 2)
+            if depth_samples else 0.0,
+            "open_loop_queue_depth_max": max(depth_samples, default=0),
+            # histogram sum/count ARE interval-diffable (a running max
+            # is not), so the open loop's coalescing reports as a mean
+            "open_loop_batch_mean": round(
+                open_delta.get("serve.batch_size.sum", 0)
+                / max(1, open_delta.get("serve.batch_size.count", 1)), 3),
+            "open_loop_completed": open_delta.get("serve.completed", 0),
+        })
+    server.shutdown()
+    _emit()
+
+
 def main():
     import numpy as np
     _install_guards()
@@ -401,6 +564,8 @@ def main():
         return run_triangle_config(on_tpu)
     if len(sys.argv) > 1 and sys.argv[1] == "ldbc":
         return run_ldbc_config(on_tpu)
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        return run_serve_config(on_tpu)
 
     from caps_tpu.backends.local.session import LocalCypherSession
     from caps_tpu.backends.tpu.session import TPUCypherSession

@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 from caps_tpu.backends.tpu.table import (DeviceBackend, DeviceTable,
                                           FusedReplayMismatch)
+from caps_tpu.serve.errors import CancellationError
 
 _graph_epochs = itertools.count()
 
@@ -159,6 +160,9 @@ class FusedExecutor:
         self.replays = 0
         self.generic_replays = 0
         self.mismatches = 0
+        # serving micro-batches dispatched through batch() (serve/)
+        self.batches = 0
+        self.batch_members = 0
         # mode of the most recent run() — "record" | "replay" |
         # "replay_gen" | None (no key / nested).  The session's PROFILE
         # path reads this to label span granularity honestly
@@ -200,6 +204,12 @@ class FusedExecutor:
                 state["result"] = result
                 self.last_mode = state["mode"]
                 return result
+        except CancellationError:
+            # Deadline expiry / client cancel (serve/deadline.py) is not
+            # replay divergence: the recording is still sound, and a
+            # transparent re-execution would run the query AFTER its
+            # budget was already spent.
+            raise
         except Exception:
             if state["mode"] not in ("replay", "replay_gen"):
                 # ambient/record-mode failures are genuine errors; a retry
@@ -219,6 +229,20 @@ class FusedExecutor:
             self.last_mode = "record"
             with self._activate(key, {"mode": None}, force_record=True):
                 return thunk()
+
+    @contextlib.contextmanager
+    def batch(self, n: int):
+        """Batched-replay entry for the serving tier (serve/batcher.py):
+        ``n`` compatible prepared executions dispatched back-to-back as
+        one micro-batch.  Each member replays its own recorded size
+        stream sync-free, so with result materialization deferred to
+        the end of the batch (the server does this) the whole batch
+        runs as ONE uninterrupted async dispatch stream — the
+        continuous-batching shape of TPU LLM serving, with the cached
+        plan playing the compiled program's role."""
+        self.batches += 1
+        self.batch_members += n
+        yield self
 
     @contextlib.contextmanager
     def _activate(self, key: Optional[Tuple],

@@ -68,6 +68,17 @@ class TPUCypherSession(RelationalCypherSession):
             self._annotate_profile(result)
         return result
 
+    def cypher_batch(self, graph, items, scopes=None):
+        """Serving micro-batch (relational/session.py): on this backend
+        the members' fused replays dispatch back-to-back under one
+        ``fused.batch`` bracket — zero size syncs per member, and the
+        server defers materialization past the last member, so the
+        device stream stays dense across the whole batch."""
+        if self.config.use_fused and len(items) > 1:
+            with self.fused.batch(len(items)):
+                return super().cypher_batch(graph, items, scopes)
+        return super().cypher_batch(graph, items, scopes)
+
     def _annotate_profile(self, result) -> None:
         """Fused-replay-aware PROFILE epilogue (never silently wrong
         numbers): when the query REPLAYED and per-op device sync was off,
@@ -125,6 +136,8 @@ class TPUCypherSession(RelationalCypherSession):
             "fused.replays": self.fused.replays,
             "fused.generic_replays": self.fused.generic_replays,
             "fused.mismatches": self.fused.mismatches,
+            "fused.batches": self.fused.batches,
+            "fused.batch_members": self.fused.batch_members,
         })
         return snap
 

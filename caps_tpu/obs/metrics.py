@@ -17,9 +17,14 @@ Two scopes:
 Snapshots are flat ``{name: number}`` dicts; :func:`diff_snapshots`
 subtracts two of them so callers measure an interval without
 hand-rolling before/after counters (the bench's old pattern).
+
+All instruments are thread-safe (fine-grained per-instrument locks,
+plus a registry lock for get-or-create): the serving tier
+(``caps_tpu/serve/``) updates them from many threads at once.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, Optional, Sequence, Union
 
 Number = Union[int, float]
@@ -27,16 +32,24 @@ Number = Union[int, float]
 
 class Counter:
     """Monotonically increasing value (int or float — ``saved_s``-style
-    second counters are floats)."""
+    second counters are floats).
 
-    __slots__ = ("name", "value")
+    Thread-safe: ``inc`` is a read-modify-write, and serving threads
+    (caps_tpu/serve/) increment shared counters concurrently — a naked
+    ``+=`` loses updates under thread switches, so each counter carries
+    its own lock (fine-grained: hot counters never contend with each
+    other)."""
+
+    __slots__ = ("name", "value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self.value: Number = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: Number = 1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge:
@@ -70,7 +83,8 @@ class Histogram:
     """Fixed-bucket histogram (cumulative ``le`` buckets, Prometheus
     style) plus count/sum/min/max."""
 
-    __slots__ = ("name", "buckets", "counts", "count", "sum", "min", "max")
+    __slots__ = ("name", "buckets", "counts", "count", "sum", "min", "max",
+                 "_lock")
 
     def __init__(self, name: str,
                  buckets: Sequence[float] = _DEFAULT_BUCKETS):
@@ -81,28 +95,33 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # observe() updates five fields; a torn update (count bumped,
+        # sum not) would corrupt mean/percentile math under concurrency
+        self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
-        self.count += 1
-        self.sum += v
-        if self.min is None or v < self.min:
-            self.min = v
-        if self.max is None or v > self.max:
-            self.max = v
-        for i, le in enumerate(self.buckets):
-            if v <= le:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
 
     def snapshot(self) -> Dict[str, Number]:
-        out: Dict[str, Number] = {"count": self.count,
-                                  "sum": round(self.sum, 9)}
-        if self.count:
-            out["min"] = self.min
-            out["max"] = self.max
-            out["mean"] = self.sum / self.count
-        return out
+        with self._lock:
+            out: Dict[str, Number] = {"count": self.count,
+                                      "sum": round(self.sum, 9)}
+            if self.count:
+                out["min"] = self.min
+                out["max"] = self.max
+                out["mean"] = self.sum / self.count
+            return out
 
 
 class MetricsRegistry:
@@ -116,29 +135,40 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # guards the name→instrument maps (get-or-create races would
+        # hand two threads two different Counter objects for one name,
+        # silently splitting the count; snapshot() iterates the maps)
+        self._lock = threading.Lock()
 
     # -- get-or-create -------------------------------------------------
 
     def counter(self, name: str) -> Counter:
         c = self._counters.get(name)
         if c is None:
-            c = self._counters[name] = Counter(name)
+            with self._lock:
+                c = self._counters.get(name)
+                if c is None:
+                    c = self._counters[name] = Counter(name)
         return c
 
     def gauge(self, name: str,
               fn: Optional[Callable[[], Number]] = None) -> Gauge:
-        g = self._gauges.get(name)
-        if g is None:
-            g = self._gauges[name] = Gauge(name, fn)
-        elif fn is not None:
-            g.fn = fn
-        return g
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, fn)
+            elif fn is not None:
+                g.fn = fn
+            return g
 
     def histogram(self, name: str,
                   buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
         h = self._histograms.get(name)
         if h is None:
-            h = self._histograms[name] = Histogram(name, buckets)
+            with self._lock:
+                h = self._histograms.get(name)
+                if h is None:
+                    h = self._histograms[name] = Histogram(name, buckets)
         return h
 
     def observe(self, name: str, v: float) -> None:
@@ -147,20 +177,25 @@ class MetricsRegistry:
     # -- snapshots -----------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            histograms = list(self._histograms.items())
         out: Dict[str, Any] = {}
-        for name, c in self._counters.items():
+        for name, c in counters:
             out[name] = c.value
-        for name, g in self._gauges.items():
+        for name, g in gauges:
             out[name] = g.value
-        for name, h in self._histograms.items():
+        for name, h in histograms:
             for k, v in h.snapshot().items():
                 out[f"{name}.{k}"] = v
         return out
 
     def clear(self) -> None:
-        self._counters.clear()
-        self._gauges.clear()
-        self._histograms.clear()
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
 
 
 _GLOBAL = MetricsRegistry()

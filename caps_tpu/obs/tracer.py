@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 from typing import Any, Dict, Iterator, List, Optional
 
 from caps_tpu.obs import clock
@@ -136,8 +137,22 @@ class Tracer:
         self.sync_device = False
         self.max_spans = max_spans
         self.spans: List[Span] = []     # finished root spans
-        self._stack: List[Span] = []
+        # The open-span stack is PER THREAD (serving workers run
+        # admission/materialization checks concurrently with another
+        # worker's execution — a cross-thread event must not attach as
+        # a child of whatever span happens to be open over there), while
+        # finished roots funnel into the shared ``spans`` list under a
+        # lock.
+        self._tls = threading.local()
+        self._spans_lock = threading.Lock()
         self.dropped = 0                # spans beyond max_spans
+
+    @property
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
 
     # -- recording -----------------------------------------------------
 
@@ -166,12 +181,15 @@ class Tracer:
         self._attach(sp)
 
     def _attach(self, span: Span) -> None:
-        if self._stack:
-            self._stack[-1].children.append(span)
-        elif len(self.spans) < self.max_spans:
-            self.spans.append(span)
-        else:
-            self.dropped += 1
+        stack = self._stack
+        if stack:
+            stack[-1].children.append(span)
+            return
+        with self._spans_lock:
+            if len(self.spans) < self.max_spans:
+                self.spans.append(span)
+            else:
+                self.dropped += 1
 
     # -- inspection / lifecycle ----------------------------------------
 
@@ -180,9 +198,10 @@ class Tracer:
         return self._stack[-1] if self._stack else None
 
     def clear(self) -> None:
-        self.spans = []
-        self._stack = []
-        self.dropped = 0
+        with self._spans_lock:
+            self.spans = []
+            self.dropped = 0
+        self._tls.stack = []  # only the calling thread's open stack
 
     @contextlib.contextmanager
     def forced(self, sync_device: bool = False) -> Iterator["Tracer"]:
@@ -199,20 +218,31 @@ class Tracer:
 #: Disabled fallback returned when no tracer is active.
 _NULL_TRACER = Tracer(enabled=False)
 
-_active: List[Tracer] = []
+# Activation is PER THREAD: two serving threads (or two sessions on two
+# threads) must not see — or pop — each other's active tracer.
+_active_tls = threading.local()
+
+
+def _active_stack() -> List[Tracer]:
+    stack = getattr(_active_tls, "stack", None)
+    if stack is None:
+        stack = _active_tls.stack = []
+    return stack
 
 
 def active_tracer() -> Tracer:
-    """The tracer of the session currently executing a query, or a
-    shared disabled tracer.  Used by instrumentation that has no session
-    handle (collectives, device-backend accounting)."""
-    return _active[-1] if _active else _NULL_TRACER
+    """The tracer of the session currently executing a query ON THIS
+    THREAD, or a shared disabled tracer.  Used by instrumentation that
+    has no session handle (collectives, device-backend accounting)."""
+    stack = _active_stack()
+    return stack[-1] if stack else _NULL_TRACER
 
 
 @contextlib.contextmanager
 def activate(tracer: Tracer) -> Iterator[Tracer]:
-    _active.append(tracer)
+    stack = _active_stack()
+    stack.append(tracer)
     try:
         yield tracer
     finally:
-        _active.pop()
+        stack.pop()

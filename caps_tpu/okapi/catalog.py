@@ -8,6 +8,7 @@ mount empty; SURVEY.md §2, §3.3).
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional, Tuple, Union
 
 from caps_tpu.okapi.graph import (
@@ -59,12 +60,19 @@ class CypherCatalog(PropertyGraphCatalog):
         # and the session plan cache's catalog fingerprint
         self.version = 0
         self._listeners: list = []
+        # Serializes mutations: store/delete + the version bump + the
+        # subscription fan-out (plan-cache eviction) must be atomic, or
+        # two serving threads interleaving mutations could leave the
+        # fingerprint bumped with stale entries still cached.  Reentrant
+        # because a listener may legitimately read the catalog back.
+        self._lock = threading.RLock()
 
     def subscribe(self, fn) -> None:
         """Register a callback invoked with the new version after every
         catalog mutation (the session plan cache evicts dependent
         entries through this)."""
-        self._listeners.append(fn)
+        with self._lock:
+            self._listeners.append(fn)
 
     def _bump(self) -> None:
         self.version += 1
@@ -78,18 +86,20 @@ class CypherCatalog(PropertyGraphCatalog):
     def register_source(self, namespace: Namespace, source: PropertyGraphDataSource) -> None:
         if isinstance(namespace, str):
             namespace = Namespace(namespace)
-        if namespace in self._sources:
-            raise ValueError(f"namespace {namespace!r} already registered")
-        self._sources[namespace] = source
-        self._bump()
+        with self._lock:
+            if namespace in self._sources:
+                raise ValueError(f"namespace {namespace!r} already registered")
+            self._sources[namespace] = source
+            self._bump()
 
     def deregister_source(self, namespace: Namespace) -> None:
         if isinstance(namespace, str):
             namespace = Namespace(namespace)
         if namespace == Namespace():
             raise ValueError("cannot deregister the session namespace")
-        if self._sources.pop(namespace, None) is not None:
-            self._bump()  # resolvable graphs changed: dependents are stale
+        with self._lock:
+            if self._sources.pop(namespace, None) is not None:
+                self._bump()  # resolvable graphs changed: dependents are stale
 
     def source(self, namespace: Namespace) -> PropertyGraphDataSource:
         if isinstance(namespace, str):
@@ -115,13 +125,15 @@ class CypherCatalog(PropertyGraphCatalog):
 
     def store(self, name: NameLike, graph: PropertyGraph) -> None:
         qgn = _qualify(name)
-        self.source(qgn.namespace).store(qgn.graph_name, graph)
-        self._bump()
+        with self._lock:
+            self.source(qgn.namespace).store(qgn.graph_name, graph)
+            self._bump()
 
     def delete(self, name: NameLike) -> None:
         qgn = _qualify(name)
-        self.source(qgn.namespace).delete(qgn.graph_name)
-        self._bump()
+        with self._lock:
+            self.source(qgn.namespace).delete(qgn.graph_name)
+            self._bump()
 
     def graph_names(self) -> Tuple[QualifiedGraphName, ...]:
         out = []

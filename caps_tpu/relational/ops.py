@@ -28,6 +28,7 @@ from caps_tpu.okapi.types import (
 )
 from caps_tpu.relational.header import HeaderError, RecordHeader
 from caps_tpu.relational.table import AggSpec, Table
+from caps_tpu.serve.deadline import checkpoint as _cancel_checkpoint
 
 
 ENTITY_CTX_PARAM = "__entity_ctx__"
@@ -193,6 +194,11 @@ class RelationalOperator(abc.ABC):
     @property
     def result(self) -> Tuple[RecordHeader, Table]:
         if self._result is None:
+            # Cooperative cancel/deadline boundary (serve/deadline.py):
+            # a served request with an expired budget stops HERE, before
+            # the next operator computes — one thread-local read when no
+            # scope is installed.
+            _cancel_checkpoint("execute")
             name = type(self).__name__.removesuffix("Op")
             tracer = self.context.tracer
             tr_span = (tracer.span(f"op.{name}", kind="operator")

@@ -32,31 +32,45 @@ table)`` pair, swap the shared runtime context's parameter dict, and pull
 ``root.result`` again.  Operator trees hold no per-run state beyond that
 memo (results are captured by the returned records object), so between
 executions a cached plan retains no tables or device buffers.
+
+Concurrency (the serving tier, ``caps_tpu/serve/``): the cache's LRU
+dict is guarded by one lock, and each :class:`CachedPlan` carries its
+own ``exec_lock`` — two threads that hit the SAME entry take turns
+re-binding/executing its shared operator tree, while different entries
+execute independently.
 """
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import threading
 from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 
 from caps_tpu.okapi.types import from_python
 
 _plan_tokens = itertools.count(1)
+_plan_token_lock = threading.Lock()
 
 
 def graph_plan_token(graph) -> Optional[int]:
     """A stable identity for a graph object, stamped on first use
     (``id()`` alone can be reused after gc — same technique as the fused
     executor's graph epoch).  None = this graph cannot anchor a cache
-    entry."""
+    entry.  The first-use stamp is locked: concurrent serving threads
+    submitting against a fresh graph must agree on ONE token, or their
+    cache keys (and micro-batch keys) silently diverge."""
     tok = getattr(graph, "_plan_token", None)
     if tok is None:
-        tok = next(_plan_tokens)
-        try:
-            graph._plan_token = tok
-        except Exception:
-            return None
+        with _plan_token_lock:
+            tok = getattr(graph, "_plan_token", None)
+            if tok is not None:
+                return tok
+            tok = next(_plan_tokens)
+            try:
+                graph._plan_token = tok
+            except Exception:
+                return None
     return tok
 
 
@@ -216,6 +230,12 @@ class CachedPlan:
     spec_key: Tuple                 # value specializations (see PlanParams)
     cold_phase_s: float             # parse+ir+plan+relational of the cold run
     nbytes: int                     # rough host-side footprint estimate
+    # Serializes executions of THIS plan: the operator tree and its
+    # runtime context are shared mutable state (parameter dict, per-op
+    # result memos), so concurrent serving threads that hit the same
+    # entry take turns — per-plan, not cache-wide (see session._run_cached).
+    exec_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
 
 
 def reset_plan(root) -> None:
@@ -266,6 +286,10 @@ class PlanCache:
         self.enabled = enabled
         self._entries: "OrderedDict[Tuple, List[CachedPlan]]" = OrderedDict()
         self._count = 0
+        # Guards _entries/_count: lookup's LRU move_to_end, store's
+        # append+evict, and the catalog-subscription eviction all mutate
+        # the OrderedDict and may run on different serving threads.
+        self._lock = threading.RLock()
         self.metrics = registry if registry is not None else MetricsRegistry()
         self._hits = self.metrics.counter("plan_cache.hits")
         self._misses = self.metrics.counter("plan_cache.misses")
@@ -299,53 +323,57 @@ class PlanCache:
 
     def lookup(self, key: Tuple,
                params: Mapping[str, Any]) -> Optional[CachedPlan]:
-        plans = self._entries.get(key)
-        if plans:
-            for plan in plans:
-                if not plan.spec_key:
-                    match = True
-                else:
-                    match = PlanParams.recompute_spec_key(
-                        plan.spec_key, params) == plan.spec_key
-                if match:
-                    self._entries.move_to_end(key)
-                    self._hits.inc()
-                    self._saved_s.inc(plan.cold_phase_s)
-                    return plan
+        with self._lock:
+            plans = self._entries.get(key)
+            if plans:
+                for plan in plans:
+                    if not plan.spec_key:
+                        match = True
+                    else:
+                        match = PlanParams.recompute_spec_key(
+                            plan.spec_key, params) == plan.spec_key
+                    if match:
+                        self._entries.move_to_end(key)
+                        self._hits.inc()
+                        self._saved_s.inc(plan.cold_phase_s)
+                        return plan
         self._misses.inc()
         return None
 
     def store(self, key: Tuple, plan: CachedPlan) -> None:
-        plans = self._entries.setdefault(key, [])
-        # replace an entry with the same specialization tokens (e.g. a
-        # re-plan after the fused executor re-recorded)
-        for i, p in enumerate(plans):
-            if p.spec_key == plan.spec_key:
-                plans[i] = plan
-                self._entries.move_to_end(key)
-                return
-        plans.append(plan)
-        self._count += 1
-        self._entries.move_to_end(key)
-        while self._count > self.max_size and self._entries:
-            _, dropped = self._entries.popitem(last=False)
-            self._count -= len(dropped)
-            self._evictions.inc(len(dropped))
+        with self._lock:
+            plans = self._entries.setdefault(key, [])
+            # replace an entry with the same specialization tokens (e.g. a
+            # re-plan after the fused executor re-recorded)
+            for i, p in enumerate(plans):
+                if p.spec_key == plan.spec_key:
+                    plans[i] = plan
+                    self._entries.move_to_end(key)
+                    return
+            plans.append(plan)
+            self._count += 1
+            self._entries.move_to_end(key)
+            while self._count > self.max_size and self._entries:
+                _, dropped = self._entries.popitem(last=False)
+                self._count -= len(dropped)
+                self._evictions.inc(len(dropped))
 
     def evict_stale(self, catalog_version: int) -> int:
         """Explicit invalidation: drop every entry planned under an older
         catalog fingerprint (key position 2).  Such entries could never
         be served again — the fingerprint is part of the key — but
         eager eviction frees the plans (and the graphs they pin)."""
-        stale = [k for k in self._entries if k[2] != catalog_version]
-        for k in stale:
-            self._count -= len(self._entries.pop(k))
-            self._invalidations.inc()
-        return len(stale)
+        with self._lock:
+            stale = [k for k in self._entries if k[2] != catalog_version]
+            for k in stale:
+                self._count -= len(self._entries.pop(k))
+                self._invalidations.inc()
+            return len(stale)
 
     def clear(self) -> None:
-        self._entries.clear()
-        self._count = 0
+        with self._lock:
+            self._entries.clear()
+            self._count = 0
 
     @property
     def size(self) -> int:
@@ -353,15 +381,18 @@ class PlanCache:
 
     def stats(self) -> Dict[str, Any]:
         total = self.hits + self.misses
+        with self._lock:
+            entries = self._count
+            nbytes = sum(p.nbytes for plans in self._entries.values()
+                         for p in plans)
         return {
-            "entries": self._count,
+            "entries": entries,
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
             "invalidations": self.invalidations,
             "hit_rate": (self.hits / total) if total else 0.0,
-            "bytes": sum(p.nbytes for plans in self._entries.values()
-                         for p in plans),
+            "bytes": nbytes,
             "saved_s": self.saved_s,
         }
 

@@ -42,6 +42,7 @@ from caps_tpu.relational.plan_cache import (
 )
 from caps_tpu.relational.planner import RelationalPlanner
 from caps_tpu.relational.table import Table, TableFactory
+from caps_tpu.serve.deadline import cancel_scope, checkpoint
 
 
 class NondeterministicResultError(RuntimeError):
@@ -312,6 +313,37 @@ class RelationalCypherSession(CypherSession):
         parse/IR/logical/relational planning entirely."""
         return PreparedQuery(self, query, graph)
 
+    def cypher_batch(self, graph: RelationalCypherGraph,
+                     items: List[Tuple[str, Mapping[str, Any]]],
+                     scopes: Optional[List] = None) -> List[Any]:
+        """Micro-batched execution (the serving tier's hot path —
+        ``caps_tpu/serve/batcher.py``): ``items`` is a list of
+        ``(query, params)`` pairs that share one plan-cache key family,
+        executed back-to-back as ONE batch — a single tracer span, and
+        after the first member every later one re-binds the same cached
+        plan, so the whole batch runs without re-entering the scalar
+        frontend (on the TPU backend the members' fused replays
+        dispatch as one uninterrupted async stream).
+
+        Returns a list aligned with ``items``; each element is the
+        member's CypherResult *or the exception it raised* — one
+        member's deadline expiry must not fail the rest of the batch.
+        ``scopes`` optionally installs a per-member
+        :class:`~caps_tpu.serve.deadline.CancelScope`."""
+        out: List[Any] = []
+        with self._observed(), self.tracer.span("batch", kind="query",
+                                                n=len(items)):
+            for i, (query, params) in enumerate(items):
+                scope = scopes[i] if scopes is not None else None
+                try:
+                    with cancel_scope(scope):
+                        out.append(self.cypher_on_graph(graph, query,
+                                                        params))
+                except Exception as ex:
+                    out.append(ex)
+        self.metrics_registry.observe("session.batch_size", len(items))
+        return out
+
     def cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
                         parameters: Optional[Mapping[str, Any]] = None
                         ) -> CypherResult:
@@ -491,6 +523,7 @@ class RelationalCypherSession(CypherSession):
         plan_params = PlanParams(params)
         with tracer.span("parse", kind="phase"):
             stmt = parse_query(query)
+        checkpoint("parse")
 
         t1 = clock.now()
         with tracer.span("ir", kind="phase"):
@@ -506,6 +539,7 @@ class RelationalCypherSession(CypherSession):
 
         logical, context, rel_planner, root, t3 = self._plan_ir(
             graph, ir, plan_params, params)
+        checkpoint("plan")
         t4 = clock.now()
 
         plans = {"ir": ir.pretty(), "logical": logical.pretty(),
@@ -527,6 +561,7 @@ class RelationalCypherSession(CypherSession):
                 records = RelationalCypherRecords(
                     self, header, table, logical.result_fields,
                     graph=rel_planner.current_graph)
+        checkpoint("execute")
         t5 = clock.now()
 
         metrics = {
@@ -578,15 +613,34 @@ class RelationalCypherSession(CypherSession):
         per-run memos, and pull the root's result.  parse/ir/plan/
         relational metrics are ~0 by construction (only the cache lookup
         preceded this)."""
-        context = plan.context
-        context.rebind(params)
-        reset_plan(plan.root)
-        t1 = clock.now()
-        with self.tracer.span("execute", kind="phase", plan_cache="hit"):
-            header, table = plan.root.result
-            records = RelationalCypherRecords(
-                self, header, table, plan.result_fields,
-                graph=plan.records_graph)
+        # The plan's operator tree and runtime context are shared
+        # mutable state (parameter dict, per-op result memos): concurrent
+        # executions of the SAME cached plan serialize on its lock —
+        # different plans still run independently (fine-grained, not a
+        # cache-wide lock).
+        with plan.exec_lock:
+            context = plan.context
+            context.rebind(params)
+            reset_plan(plan.root)
+            t1 = clock.now()
+            try:
+                with self.tracer.span("execute", kind="phase",
+                                      plan_cache="hit"):
+                    header, table = plan.root.result
+                    records = RelationalCypherRecords(
+                        self, header, table, plan.result_fields,
+                        graph=plan.records_graph)
+                op_metrics = context.op_metrics
+                result_profile = (obs.profile_tree(plan.root, context)
+                                  if self._profiling else None)
+            finally:
+                # the records object owns (header, table) now; the
+                # parked tree must not pin device buffers until its next
+                # execution — including when a deadline/cancel aborted
+                # the run mid-tree (a routine serving path) with partial
+                # operator memos already computed
+                reset_plan(plan.root)
+        checkpoint("execute")
         t2 = clock.now()
         if self.config.print_ir:
             print(plan.plans["ir"])
@@ -599,17 +653,12 @@ class RelationalCypherSession(CypherSession):
             "plan_cache_lookup_s": t1 - t0,
             "execute_s": t2 - t1,
             "rows": table.size_hint(),
-            "operators": context.op_metrics,
+            "operators": op_metrics,
             "bytes_touched": sum(m.get("bytes_in", 0)
-                                 for m in context.op_metrics),
+                                 for m in op_metrics),
             "plan_cache": "hit",
             "plan_cache_saved_s": plan.cold_phase_s,
         }
-        result_profile = (obs.profile_tree(plan.root, context)
-                          if self._profiling else None)
-        # the records object owns (header, table) now; the parked tree
-        # must not pin device buffers until its next execution
-        reset_plan(plan.root)
         if self.config.print_timings:
             print(f"[caps-tpu] timings: {metrics}")
         logger.debug("query %r: %d rows in %.1f ms (plan cache hit)",

@@ -1,0 +1,56 @@
+"""caps_tpu serving tier: concurrent multi-client query service.
+
+The layer between many client threads and one engine session
+(ROADMAP north star: heavy traffic through a TPU-resident graph):
+
+    serve/errors.py     typed failure surface (Overloaded w/ retry_after,
+                        DeadlineExceeded w/ phase attribution, Cancelled)
+    serve/deadline.py   per-request budgets + cooperative cancel scopes,
+                        checkpointed at engine phase boundaries
+    serve/request.py    Request + the client-facing QueryHandle future
+    serve/admission.py  bounded priority queue: admit or shed, never
+                        queue unboundedly; graceful drain
+    serve/batcher.py    micro-batching of plan-cache-compatible requests
+    serve/server.py     QueryServer: worker pool, one serialized device
+                        stream, serve.* metrics
+
+Engine hooks this package owns: ``RelationalCypherSession.cypher_batch``
+(one batched pass over a cached plan), the deadline checkpoints in
+``relational/session.py`` / ``relational/ops.py``, and the fused
+executor's batched-replay accounting (``backends/tpu/fused.py``).
+
+``errors`` and ``deadline`` load eagerly (the engine imports them);
+the server stack loads on first attribute access so importing the
+relational layer never pulls in the whole tier.
+"""
+from caps_tpu.serve.deadline import (CancelScope, cancel_scope, checkpoint,
+                                     current_scope)
+from caps_tpu.serve.errors import (Cancelled, CancellationError,
+                                   DeadlineExceeded, Overloaded, ServeError,
+                                   ServerClosed)
+
+_LAZY = {
+    "QueryServer": "caps_tpu.serve.server",
+    "ServerConfig": "caps_tpu.serve.server",
+    "AdmissionController": "caps_tpu.serve.admission",
+    "MicroBatcher": "caps_tpu.serve.batcher",
+    "batch_key": "caps_tpu.serve.batcher",
+    "QueryHandle": "caps_tpu.serve.request",
+    "Request": "caps_tpu.serve.request",
+    "INTERACTIVE": "caps_tpu.serve.request",
+    "BATCH": "caps_tpu.serve.request",
+}
+
+__all__ = [
+    "ServeError", "ServerClosed", "Overloaded", "CancellationError",
+    "DeadlineExceeded", "Cancelled", "CancelScope", "cancel_scope",
+    "checkpoint", "current_scope", *sorted(_LAZY),
+]
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod), name)

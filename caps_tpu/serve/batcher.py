@@ -1,0 +1,80 @@
+"""Micro-batching: group compatible in-flight requests into one run.
+
+The serving analogue of continuous batching in TPU LLM inference
+(PAPERS.md, *Ragged Paged Attention*): throughput comes from pushing
+many small requests through one compiled program.  Here the compiled
+program is a cached prepared plan — requests are compatible when they
+would hit the SAME plan-cache entry family, i.e. share
+
+    (graph plan token, normalized query text, parameter signature)
+
+which is exactly the session plan cache's value-independent key minus
+the catalog fingerprint (the batch executes at one instant, so all
+members see the same catalog).  A batch executes as one pass over the
+cached operator tree — one cache lookup, one plan lock, one tracer
+span — with per-member parameter rebinding; on the TPU backend the
+members' fused replays dispatch back-to-back as one uninterrupted
+async stream (backends/tpu/fused.py ``batch``).
+
+Never batched (batch key None): EXPLAIN/PROFILE requests (PROFILE
+mutates session profiling state and must run alone), queries against
+graphs that cannot anchor a plan-cache entry, and parameter sets whose
+signatures diverge — those fall back to per-request execution.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Mapping, Optional, Tuple
+
+from caps_tpu.serve.admission import AdmissionController
+from caps_tpu.serve.request import Request
+
+
+def batch_key(graph: Any, query: str,
+              params: Mapping[str, Any]) -> Tuple[Optional[str],
+                                                  Optional[Tuple]]:
+    """(query mode, batch compatibility key).  Key None = never batch."""
+    from caps_tpu.frontend.parser import normalize_query, query_mode
+    from caps_tpu.relational.plan_cache import (graph_plan_token,
+                                                param_signature)
+    mode, body = query_mode(query)
+    if mode is not None:
+        return mode, None
+    gtok = graph_plan_token(graph)
+    if gtok is None:
+        return None, None
+    try:
+        sig = param_signature(params)
+    except Exception:
+        return None, None
+    return None, (gtok, normalize_query(body), sig)
+
+
+class MicroBatcher:
+    """Pulls a leader from the admission queue, then gathers compatible
+    followers — everything already queued, plus (optionally) whatever
+    arrives inside ``window_s``.  ``window_s`` trades leader latency
+    for batch size; the default 0 batches only what is already there."""
+
+    def __init__(self, admission: AdmissionController, max_batch: int = 8,
+                 window_s: float = 0.0):
+        self.admission = admission
+        self.max_batch = max(1, int(max_batch))
+        self.window_s = float(window_s)
+
+    def next_batch(self, timeout: Optional[float] = None) -> List[Request]:
+        leader = self.admission.take(timeout)
+        if leader is None:
+            return []
+        if leader.batch_key is None or self.max_batch == 1:
+            return [leader]
+        if self.window_s > 0:
+            # don't wait past the leader's own deadline
+            window = self.window_s
+            rem = leader.scope.remaining()
+            if rem is not None:
+                window = min(window, max(0.0, rem))
+            self.admission.wait_for_compatible(
+                leader.batch_key, self.max_batch - 1, window)
+        followers = self.admission.take_compatible(
+            leader.batch_key, self.max_batch - 1)
+        return [leader] + followers

@@ -1,0 +1,70 @@
+"""Typed errors of the serving tier.
+
+Every failure mode a client of :class:`caps_tpu.serve.QueryServer` can
+see is a distinct exception type carrying machine-usable fields — a
+load-shedding client retries after ``Overloaded.retry_after_s``, a
+deadline miss reports *which pipeline phase* consumed the budget
+(``DeadlineExceeded.phase``) so capacity planning can tell a planning
+stall from a device stall from queue pressure.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ServeError(RuntimeError):
+    """Base class for all serving-tier errors."""
+
+
+class ServerClosed(ServeError):
+    """submit() after shutdown() began: the server accepts no new work."""
+
+
+class Overloaded(ServeError):
+    """Admission control shed this request instead of queuing unboundedly.
+
+    ``retry_after_s`` is the server's estimate of when capacity frees up
+    (queue depth x recent per-request service time / workers) — the
+    back-off hint a well-behaved client honors."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0,
+                 queue_depth: int = 0, priority: int = 0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.queue_depth = queue_depth
+        self.priority = priority
+
+
+class CancellationError(ServeError):
+    """Base of the two cooperative-cancel outcomes (deadline, explicit).
+
+    The fused executor re-raises these immediately instead of treating
+    them as replay divergence: a query killed by its budget must not be
+    transparently re-executed."""
+
+    def __init__(self, message: str, phase: str = "?"):
+        super().__init__(message)
+        #: pipeline phase at which the cancellation was observed
+        #: (queued | parse | plan | execute | materialize)
+        self.phase = phase
+
+
+class DeadlineExceeded(CancellationError):
+    """The request's deadline expired; ``phase`` attributes the budget."""
+
+    def __init__(self, phase: str, budget_s: Optional[float],
+                 elapsed_s: float):
+        super().__init__(
+            f"deadline exceeded in phase {phase!r} "
+            f"(budget {budget_s if budget_s is not None else '?'} s, "
+            f"elapsed {elapsed_s:.4f} s)", phase=phase)
+        self.budget_s = budget_s
+        self.elapsed_s = elapsed_s
+
+
+class Cancelled(CancellationError):
+    """The client cancelled the request (``QueryHandle.cancel()``)."""
+
+    def __init__(self, phase: str = "queued"):
+        super().__init__(f"request cancelled in phase {phase!r}",
+                         phase=phase)

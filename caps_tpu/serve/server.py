@@ -1,0 +1,336 @@
+"""QueryServer: the multi-client query-serving tier.
+
+Turns a single engine session into a service: clients ``submit()``
+queries from any thread and get Future-style handles back; a worker
+pool executes them through the session's prepared-plan path with
+
+* **admission control** — a bounded priority queue that sheds load with
+  a typed ``Overloaded`` (retry_after hint) instead of queuing
+  unboundedly (serve/admission.py);
+* **micro-batching** — compatible in-flight requests (same normalized
+  query / plan-cache key family) execute as one batched pass over the
+  cached plan (serve/batcher.py, ``session.cypher_batch``);
+* **deadlines + cooperative cancellation** — per-request budgets
+  checked at engine phase boundaries (serve/deadline.py), with the
+  expiry phase attributed in the error and the trace.
+
+Execution is serialized through one lock by default: the engine drives
+ONE device, and on TPU throughput comes from keeping that device's
+dispatch stream dense (fused replay + batching), not from concurrent
+host threads racing into it.  Workers still overlap usefully — while
+one executes, others admit, time out, and materialize results.  The
+engine-side structures a serving session shares across threads (plan
+cache, catalog, metrics registry) are individually locked, so the
+submit path never contends with execution.
+
+Serving metrics land in the session's registry under ``serve.*``
+(queue depth gauge, admitted/shed/completed counters, latency +
+queue-wait + batch-size histograms) and show up in
+``session.metrics_snapshot()`` next to everything else.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Dict, List, Mapping, Optional
+
+from caps_tpu.obs import clock
+from caps_tpu.serve import batcher as _batcher
+from caps_tpu.serve.admission import AdmissionController
+from caps_tpu.serve.batcher import MicroBatcher
+from caps_tpu.serve.deadline import CancelScope, cancel_scope
+from caps_tpu.serve.errors import (Cancelled, CancellationError,
+                                   DeadlineExceeded, ServerClosed)
+from caps_tpu.serve.request import INTERACTIVE, QueryHandle, Request
+
+_UNSET = object()
+
+#: batch-size histogram buckets (powers of two up to the queue bound)
+_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+_session_locks_guard = threading.Lock()
+
+
+def _session_exec_lock(session) -> threading.Lock:
+    """The ONE execution lock of a session, attached on first use: every
+    QueryServer over the same session must serialize through the same
+    lock (the engine's execution state — fused record/replay activation,
+    profiling flags — is per-session, not per-server)."""
+    lock = getattr(session, "_serve_exec_lock", None)
+    if lock is None:
+        with _session_locks_guard:
+            lock = getattr(session, "_serve_exec_lock", None)
+            if lock is None:
+                lock = threading.Lock()
+                session._serve_exec_lock = lock
+    return lock
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    #: worker threads; execution itself is serialized (one device
+    #: stream), extra workers overlap admission and materialization
+    workers: int = 2
+    #: global queue bound — beyond it submit() sheds with Overloaded
+    max_queue: int = 64
+    #: optional per-priority queue caps, e.g. {BATCH: 16} keeps
+    #: background traffic from filling the queue
+    per_priority_limits: Optional[Dict[int, int]] = None
+    #: max requests coalesced into one micro-batch
+    max_batch: int = 8
+    #: seconds a batch leader waits for followers (0 = batch only what
+    #: is already queued — no added leader latency)
+    batch_window_s: float = 0.0
+    #: default per-request budget (None = no deadline)
+    default_deadline_s: Optional[float] = None
+    default_priority: int = INTERACTIVE
+    #: materialize rows on the worker (handle.rows() is then free)
+    materialize: bool = True
+
+
+class QueryServer:
+    """Concurrent serving facade over one session.
+
+    >>> server = QueryServer(session, graph=g)
+    >>> h = server.submit("MATCH (n:Person) WHERE n.age > $a "
+    ...                   "RETURN n.name AS name", {"a": 30})
+    >>> h.rows()
+    [...]
+    >>> server.shutdown()
+    """
+
+    def __init__(self, session, graph=None,
+                 config: Optional[ServerConfig] = None, start: bool = True):
+        self.session = session
+        self.config = config or ServerConfig()
+        self._default_graph = graph if graph is not None \
+            else session._ambient
+        registry = session.metrics_registry
+        self.admission = AdmissionController(
+            registry, max_queue=self.config.max_queue,
+            per_priority_limits=self.config.per_priority_limits,
+            workers=self.config.workers)
+        self.batcher = MicroBatcher(self.admission,
+                                    max_batch=self.config.max_batch,
+                                    window_s=self.config.batch_window_s)
+        # ONE device stream: execution is serialized; workers overlap
+        # on admission, timeout handling, and materialization.  The
+        # lock is per-SESSION (shared by every server over it).
+        self._exec_lock = _session_exec_lock(session)
+        self._completed = registry.counter("serve.completed")
+        self._failed = registry.counter("serve.failed")
+        self._cancelled = registry.counter("serve.cancelled")
+        self._deadline_exceeded = registry.counter("serve.deadline_exceeded")
+        self._batches = registry.counter("serve.batches")
+        self._batch_hist = registry.histogram("serve.batch_size",
+                                              buckets=_BATCH_BUCKETS)
+        self._latency = registry.histogram("serve.latency_s")
+        self._queue_wait = registry.histogram("serve.queue_wait_s")
+        self._registry = registry
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "QueryServer":
+        """Start the worker pool (idempotent).  ``start=False`` at
+        construction lets tests and benchmarks pre-load the queue so the
+        first batch demonstrably coalesces."""
+        if self._started:
+            return self
+        self._started = True
+        for i in range(max(1, self.config.workers)):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"caps-tpu-serve-{i}", daemon=True)
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> bool:
+        """Stop accepting work.  ``drain=True`` (default) completes
+        everything already queued before workers exit; ``drain=False``
+        fails queued requests with ``Cancelled``.  ``timeout`` bounds
+        the TOTAL wait for workers; returns False (with the worker
+        handles retained, so a later call can finish the join) when
+        they are still running at the deadline."""
+        self.admission.close()
+        if not drain:
+            for req in self.admission.drain_remaining():
+                req.scope.cancel()
+                req.handle._complete(
+                    exception=Cancelled(phase="queued"))
+                self._cancelled.inc()
+        elif not self._started and self.admission.depth() > 0:
+            # never-started server with a backlog: draining means the
+            # queued work still completes — spin the workers up; they
+            # exit once the (closed) queue is empty
+            self.start()
+        if not self._started:
+            return True
+        deadline = None if timeout is None else clock.now() + timeout
+        for t in self._threads:
+            t.join(None if deadline is None
+                   else max(0.0, deadline - clock.now()))
+        still_running = [t for t in self._threads if t.is_alive()]
+        self._threads = still_running
+        return not still_running
+
+    def __enter__(self) -> "QueryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=True)
+
+    # -- client API ----------------------------------------------------
+
+    def submit(self, query: str,
+               parameters: Optional[Mapping[str, Any]] = None, *,
+               graph=None, deadline_s: Any = _UNSET,
+               priority: Optional[int] = None) -> QueryHandle:
+        """Enqueue a query; returns immediately with a handle.
+
+        Raises :class:`ServerClosed` after shutdown began and
+        :class:`Overloaded` when admission sheds the request —
+        synchronous, so the caller's backpressure is immediate.
+        ``deadline_s`` is the request's total budget (queue wait
+        included); ``deadline_s=None`` explicitly disables the
+        server-default deadline for this request."""
+        if deadline_s is _UNSET:
+            deadline_s = self.config.default_deadline_s
+        if priority is None:
+            priority = self.config.default_priority
+        graph = graph if graph is not None else self._default_graph
+        params = dict(parameters or {})
+        scope = CancelScope(budget_s=deadline_s)
+        mode, key = _batcher.batch_key(graph, query, params)
+        req = Request(query, params, graph, priority, scope, key, mode)
+        self.admission.offer(req)  # may raise ServerClosed / Overloaded
+        return req.handle
+
+    def run(self, query: str,
+            parameters: Optional[Mapping[str, Any]] = None,
+            **kwargs) -> Any:
+        """submit + result(): the blocking convenience call."""
+        return self.submit(query, parameters, **kwargs).result()
+
+    def stats(self) -> Dict[str, Any]:
+        """The ``serve.*`` slice of the metrics registry, unprefixed."""
+        snap = self._registry.snapshot()
+        return {k[len("serve."):]: v for k, v in snap.items()
+                if k.startswith("serve.")}
+
+    # -- worker pool ---------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            # blocking take: idle workers sleep on the queue's condition
+            # variable (close() wakes them) instead of polling
+            batch = self.batcher.next_batch(timeout=None)
+            if not batch:
+                if self.admission.closed:
+                    return
+                continue
+            try:
+                self._execute_batch(batch)
+            except BaseException as ex:  # pragma: no cover — last resort
+                for req in batch:
+                    if not req.handle.done():
+                        req.handle._complete(exception=ex)
+
+    def _observed(self):
+        """Activate the session tracer for worker-side checks (queue
+        admission, materialization) so their deadline events land in
+        the trace like the engine-side ones do.  Reuses the session's
+        own activation helper (one enabled-check contract)."""
+        session_observed = getattr(self.session, "_observed", None)
+        if session_observed is not None:
+            return session_observed()
+        return contextlib.nullcontext()
+
+    def _admit_for_execution(self, batch: List[Request]) -> List[Request]:
+        """Drop members that were cancelled or expired while queued and
+        complete their handles; record queue wait for the rest."""
+        live: List[Request] = []
+        now = clock.now()
+        for req in batch:
+            if req.drop_cancelled():
+                self._cancelled.inc()
+                continue
+            try:
+                with self._observed():
+                    req.scope.raise_if_done("queued")
+            except CancellationError as ex:
+                self._count_failure(ex)
+                req.handle._complete(exception=ex)
+                continue
+            wait_s = now - req.enqueued_t
+            req.handle.info["queue_wait_s"] = wait_s
+            self._queue_wait.observe(wait_s)
+            live.append(req)
+        return live
+
+    def _execute_batch(self, batch: List[Request]) -> None:
+        live = self._admit_for_execution(batch)
+        if not live:
+            return
+        n = len(live)
+        self._batches.inc()
+        self._batch_hist.observe(n)
+        for req in live:
+            req.handle.info["batch_size"] = n
+        with self._exec_lock:
+            # service time starts INSIDE the lock: time spent queued
+            # behind another worker's batch is queueing, not service,
+            # and must not inflate the retry_after estimator
+            t0 = clock.now()
+            if n > 1:
+                outcomes = self.session.cypher_batch(
+                    live[0].graph, [(r.query, r.params) for r in live],
+                    scopes=[r.scope for r in live])
+            else:
+                req = live[0]
+                try:
+                    with cancel_scope(req.scope):
+                        outcomes = [self.session.cypher_on_graph(
+                            req.graph, req.query, req.params)]
+                except BaseException as ex:
+                    outcomes = [ex]
+            exec_s = clock.now() - t0
+        # feed the admission controller's retry_after estimator
+        self.admission.observe_service(exec_s / n)
+        for req, outcome in zip(live, outcomes):
+            self._finish(req, outcome)
+
+    def _finish(self, req: Request, outcome: Any) -> None:
+        """Materialize (deadline-checked) and complete one handle."""
+        if isinstance(outcome, BaseException):
+            self._count_failure(outcome)
+            req.handle._complete(exception=outcome)
+            return
+        rows = None
+        try:
+            with cancel_scope(req.scope), self._observed():
+                if self.config.materialize:
+                    req.scope.raise_if_done("materialize")
+                    rows = outcome.to_maps()
+                    req.scope.raise_if_done("materialize")
+        except BaseException as ex:
+            self._count_failure(ex)
+            req.handle._complete(exception=ex)
+            return
+        req.handle.info["latency_s"] = req.scope.elapsed()
+        self._latency.observe(req.handle.info["latency_s"])
+        self._completed.inc()
+        req.handle._complete(result=outcome, rows=rows)
+
+    def _count_failure(self, ex: BaseException) -> None:
+        if isinstance(ex, DeadlineExceeded):
+            self._deadline_exceeded.inc()
+        elif isinstance(ex, Cancelled):
+            self._cancelled.inc()
+        else:
+            self._failed.inc()

@@ -1,18 +1,53 @@
-"""Test-only fault injection for the device backend.
+"""Test-only fault injection.
 
 SURVEY.md §5.3: the reference inherits failure detection from Spark
 (lineage re-execution, executor blacklisting) and ships no fault-injection
 tests of its own; single-controller JAX has no task retry, so our
 equivalent machinery is (a) deterministic replay + digest comparison
 (``EngineConfig.determinism_check`` / ``result_digest``) and (b) this
-module: a context manager that corrupts one shard's buffers on ingest so
-tests can prove the detection machinery actually notices damage.
+module: :func:`corrupt_shard` silently damages one shard's buffers on
+ingest so tests can prove the detection machinery notices, and
+:func:`slow_operator` injects a deterministic delay into one relational
+operator so deadline/cancellation paths (``caps_tpu/serve/``) are
+testable without sleep-and-hope timing races.
 """
 from __future__ import annotations
 
 import contextlib
+import time
 
 import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def slow_operator(op_name: str, delay_s: float):
+    """While active, every ``_compute`` of the named relational operator
+    class (``"Filter"`` or ``"FilterOp"``) sleeps ``delay_s`` first —
+    process-wide, so any session's queries slow down deterministically.
+
+    The serving tests use this to force a deadline to expire INSIDE the
+    execute phase: the delayed operator finishes (cancellation is
+    cooperative — dispatched work is never torn down), and the next
+    operator boundary's checkpoint raises ``DeadlineExceeded`` with
+    ``phase="execute"``.  No test ever has to guess how long a real
+    query takes."""
+    from caps_tpu.relational import ops as R
+    cls_name = op_name if op_name.endswith("Op") else op_name + "Op"
+    cls = getattr(R, cls_name, None)
+    if cls is None or not isinstance(cls, type) \
+            or not issubclass(cls, R.RelationalOperator):
+        raise ValueError(f"unknown relational operator {op_name!r}")
+    orig = cls._compute
+
+    def slowed(self):
+        time.sleep(delay_s)
+        return orig(self)
+
+    cls._compute = slowed
+    try:
+        yield
+    finally:
+        cls._compute = orig
 
 
 @contextlib.contextmanager

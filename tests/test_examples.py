@@ -57,6 +57,14 @@ def test_parameterized_reads(backend):
 
 
 @pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_serve_concurrent(backend):
+    import serve_concurrent
+    ok, batch_max = serve_concurrent.main(backend)
+    assert ok == serve_concurrent.N_CLIENTS * serve_concurrent.PER_CLIENT
+    assert batch_max > 1  # the micro-batcher demonstrably coalesced
+
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
 def test_profile_query(backend):
     import profile_query
     rows, explained, profiled, n_events = profile_query.main(backend)
