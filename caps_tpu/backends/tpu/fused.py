@@ -210,11 +210,23 @@ class FusedExecutor:
             # transparent re-execution would run the query AFTER its
             # budget was already spent.
             raise
-        except Exception:
+        except Exception as ex:
             if state["mode"] not in ("replay", "replay_gen"):
                 # ambient/record-mode failures are genuine errors; a retry
                 # under an active outer recording would double-append its
-                # sizes and corrupt the outer memo.
+                # sizes and corrupt the outer memo.  (A failed RECORD run
+                # never stores a memo: the store below the yield is
+                # skipped when the thunk raises, so a device error cannot
+                # park a partial recording.)
+                raise
+            from caps_tpu.serve.failure import TRANSIENT, classify
+            if classify(ex) == TRANSIENT:
+                # A transient device error (RESOURCE_EXHAUSTED under HBM
+                # pressure, a flapping transport) says nothing about the
+                # recording's soundness: keep the memo, don't count a
+                # mismatch, and let the serving tier's retry policy
+                # re-run — the retry replays sync-free again instead of
+                # paying a needless re-record.
                 raise
             # ANY failure during replay is treated as divergence: drop the
             # recording and re-execute in record mode (sizes served from a
@@ -229,6 +241,24 @@ class FusedExecutor:
             self.last_mode = "record"
             with self._activate(key, {"mode": None}, force_record=True):
                 return thunk()
+
+    def forget(self, graph, query: str) -> int:
+        """Quarantine hook (caps_tpu/serve/): drop every size memo —
+        exact and generic — recorded for (graph, query), so the next
+        execution re-records from scratch.  Used when the serving tier
+        suspects a poisoned memo; returns the number of entries
+        dropped."""
+        gk = getattr(graph, "_fused_epoch", None)
+        if gk is None:
+            return 0
+        gkey = (gk, query)
+        dropped = 0
+        for key in [k for k in self._memo if k[:2] == gkey]:
+            del self._memo[key]
+            dropped += 1
+        if self._generic.pop(gkey, None) is not None:
+            dropped += 1
+        return dropped
 
     @contextlib.contextmanager
     def batch(self, n: int):

@@ -63,6 +63,33 @@ class StringPool:
     def decode_many(self, codes) -> List[Optional[str]]:
         return [self.decode(int(c)) for c in codes]
 
+    # -- failure containment -------------------------------------------------
+
+    def mark(self) -> int:
+        """Checkpoint for :meth:`rollback` — take one before an ingest
+        that may fail (backends/tpu/table.py ``from_columns``)."""
+        return self.version
+
+    def rollback(self, mark: int) -> bool:
+        """Discard every string interned after ``mark``.  A failed
+        ingest (device OOM mid-placement, a flaky transport) must not
+        leave its strings behind: the pool size is the fused executor's
+        replayability fence (backends/tpu/fused.py), so leaked growth
+        from a FAILED ingest would silently invalidate every recorded
+        size stream and trigger a re-record storm on the next queries.
+        Returns True when the pool was restored (the native pool is
+        append-only and returns False — callers just accept the
+        growth)."""
+        if mark >= len(self._strings):
+            return True
+        for s in self._strings[mark:]:
+            self._codes.pop(s, None)
+        del self._strings[mark:]
+        self._rank_version = -1
+        self._rank = None
+        self._fn_luts.clear()
+        return True
+
     # -- ordering -----------------------------------------------------------
 
     def rank_array(self) -> np.ndarray:
@@ -161,6 +188,11 @@ class NativeStringPool(StringPool):
         get = native.lib.pool_get
         h = self._h
         return [get(h, int(c)) for c in codes]
+
+    def rollback(self, mark: int) -> bool:
+        # the C++ pool is append-only; report the growth un-rolled so
+        # callers can account for it (the replayability fence moves)
+        return mark >= self.version
 
     def _snapshot(self) -> List[str]:
         strings = native.lib.pool_get_all(self._h)

@@ -12,7 +12,8 @@ from caps_tpu import obs
 from caps_tpu.backends.tpu.table import DeviceBackend, DeviceTableFactory
 from caps_tpu.obs import clock
 from caps_tpu.okapi.config import DEFAULT_CONFIG
-from caps_tpu.relational.session import RelationalCypherSession
+from caps_tpu.relational.session import (RelationalCypherSession,
+                                         degraded_state)
 
 
 class TPUCypherSession(RelationalCypherSession):
@@ -41,11 +42,14 @@ class TPUCypherSession(RelationalCypherSession):
         hand-scheduled joins, strategy counts — SURVEY.md §5.5) to the
         result's metrics as per-query deltas."""
         be = self.backend
+        # degraded unfused mode (relational/session.py, serve/ failure
+        # containment): per-operator eager execution, no memo touched
+        use_fused = self.config.use_fused and not degraded_state()[1]
         before = (be.ici_bytes, be.dist_joins, be.broadcast_joins,
                   be.fallbacks, be.syncs, be.ici_payload_bytes,
                   be.salted_joins, self.fused.generic_replays
-                  if self.config.use_fused else 0)
-        if not self.config.use_fused:
+                  if use_fused else 0)
+        if not use_fused:
             result = super()._cypher_on_graph(graph, query, parameters)
         else:
             key = self.fused.key(graph, query, dict(parameters or {}))
@@ -61,7 +65,7 @@ class TPUCypherSession(RelationalCypherSession):
             result.metrics["ici_payload_bytes"] = \
                 be.ici_payload_bytes - before[5]
             result.metrics["salted_joins"] = be.salted_joins - before[6]
-            if self.config.use_fused:
+            if use_fused:
                 result.metrics["fused_generic_replays"] = \
                     self.fused.generic_replays - before[7]
         if self._profiling:

@@ -1732,19 +1732,35 @@ class DeviceTableFactory(TableFactory):
         n = len(next(iter(data.values()))) if data else 0
         cap = self.backend.bucket(n)
         cols: Dict[str, Column] = {}
-        for c, values in data.items():
-            ctype = types[c]
-            if kind_for(ctype) == "object":
-                local = self._local.from_columns(data, types)
-                return DeviceTable(self.backend, local=local)
-            try:
-                col = make_column(list(values), ctype, cap, self.backend.pool)
-            except ValueError:
-                # values the device encoding rejects (int32-overflowing
-                # list elements, null-in-list, oversized ids): host table
-                local = self._local.from_columns(data, types)
-                return DeviceTable(self.backend, local=local)
-            cols[c] = self.backend.place_column(col)
+        # Failure containment: a mid-ingest device failure (OOM during
+        # placement, a flaky transport) must not leave the strings this
+        # ingest interned behind — pool growth is the fused executor's
+        # replayability fence, and leaked growth from a FAILED ingest
+        # would silently invalidate every recorded size stream.
+        pool_mark = self.backend.pool.mark()
+        try:
+            for c, values in data.items():
+                ctype = types[c]
+                if kind_for(ctype) == "object":
+                    # host-table fallback: the local table stores raw
+                    # python values, so codes interned for the discarded
+                    # device columns roll back too (same fence argument)
+                    self.backend.pool.rollback(pool_mark)
+                    local = self._local.from_columns(data, types)
+                    return DeviceTable(self.backend, local=local)
+                try:
+                    col = make_column(list(values), ctype, cap,
+                                      self.backend.pool)
+                except ValueError:
+                    # values the device encoding rejects (int32-overflowing
+                    # list elements, null-in-list, oversized ids): host table
+                    self.backend.pool.rollback(pool_mark)
+                    local = self._local.from_columns(data, types)
+                    return DeviceTable(self.backend, local=local)
+                cols[c] = self.backend.place_column(col)
+        except Exception:
+            self.backend.pool.rollback(pool_mark)
+            raise
         return DeviceTable(self.backend, cols, n)
 
     def unit(self) -> DeviceTable:

@@ -16,3 +16,9 @@ now = _time.perf_counter
 
 #: Epoch seconds — only for human-facing timestamps, never for deltas.
 wall = _time.time
+
+#: The single sanctioned *wait* primitive (retry backoff, poll loops).
+#: Routing sleeps through here lets a test install a fake clock whose
+#: ``sleep`` advances ``now`` instantly — retry/backoff timing becomes
+#: exactly assertable with zero real waiting (tests/test_faults.py).
+sleep = _time.sleep

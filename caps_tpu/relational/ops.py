@@ -29,6 +29,7 @@ from caps_tpu.okapi.types import (
 from caps_tpu.relational.header import HeaderError, RecordHeader
 from caps_tpu.relational.table import AggSpec, Table
 from caps_tpu.serve.deadline import checkpoint as _cancel_checkpoint
+from caps_tpu.serve.errors import CancellationError as _CancellationError
 
 
 ENTITY_CTX_PARAM = "__entity_ctx__"
@@ -210,7 +211,18 @@ class RelationalOperator(abc.ABC):
                 xla_span = (_TraceAnnotation(f"caps_tpu.{name}")
                             if _TraceAnnotation is not None else nullcontext())
                 with xla_span:
-                    self._result = self._compute()
+                    try:
+                        self._result = self._compute()
+                    except _CancellationError:
+                        raise  # budget expiry, not an operator failure
+                    except Exception as ex:
+                        # only the op that ACTUALLY failed reports; the
+                        # ancestors it unwinds through (parents evaluate
+                        # children lazily inside their own _compute)
+                        # must not re-count it
+                        if getattr(ex, "caps_failed_op", None) is None:
+                            self._propagate_error(ex, name, tracer)
+                        raise
                 if tracer is not None and tracer.enabled \
                         and tracer.sync_device:
                     # PROFILE per-op device mode: wait for the dispatched
@@ -264,6 +276,28 @@ class RelationalOperator(abc.ABC):
                 sp.annotate(rows=entry["rows"], bytes=bytes_in,
                             device_s=device_s)
         return self._result
+
+    def _propagate_error(self, ex: Exception, name: str, tracer) -> None:
+        """Failure-containment telemetry for one operator failure
+        (caps_tpu/serve/failure.py consumes it): an ``op.error`` trace
+        event, an ``ops.errors`` counter tick, and the failing operator
+        stamped on the exception.  The caller gates on the stamp being
+        absent, so the whole report fires exactly once per failure —
+        at the operator that raised, not at every ancestor it unwound
+        through (and a badly-written injector sharing one exception
+        across requests keeps its first, accurate stamp)."""
+        try:
+            if tracer is not None and tracer.enabled:
+                tracer.event("op.error", kind="event", op=name,
+                             error=type(ex).__name__)
+            session = getattr(self.context, "session", None)
+            registry = getattr(session, "metrics_registry", None)
+            if registry is not None:
+                registry.counter("ops.errors").inc()
+            if getattr(ex, "caps_failed_op", None) is None:
+                ex.caps_failed_op = name
+        except Exception:  # pragma: no cover — telemetry must not mask
+            pass
 
     @property
     def header(self) -> RecordHeader:

@@ -296,6 +296,9 @@ class PlanCache:
         self._evictions = self.metrics.counter("plan_cache.evictions")
         # catalog-fingerprint evictions (CATALOG CREATE/DROP etc.)
         self._invalidations = self.metrics.counter("plan_cache.invalidations")
+        # failure-driven evictions (serve/ circuit breaker: an entry
+        # whose executions keep failing is quarantined — see quarantine())
+        self._quarantined = self.metrics.counter("plan_cache.quarantined")
         # cold-phase seconds skipped by hits
         self._saved_s = self.metrics.counter("plan_cache.saved_s")
         self.metrics.gauge("plan_cache.entries", fn=lambda: self._count)
@@ -358,6 +361,24 @@ class PlanCache:
                 self._count -= len(dropped)
                 self._evictions.inc(len(dropped))
 
+    def quarantine(self, key: Tuple) -> int:
+        """Failure containment (caps_tpu/serve/): evict every plan under
+        ``key`` because executions of it keep failing — a poisoned entry
+        (stale memo, corrupted operator state) would otherwise fail every
+        future hit on its key forever.  Returns the number of plans
+        dropped; the next execution of the query re-plans from scratch."""
+        with self._lock:
+            plans = self._entries.pop(key, None)
+            if not plans:
+                return 0
+            self._count -= len(plans)
+            self._quarantined.inc(len(plans))
+            return len(plans)
+
+    @property
+    def quarantined(self) -> int:
+        return self._quarantined.value
+
     def evict_stale(self, catalog_version: int) -> int:
         """Explicit invalidation: drop every entry planned under an older
         catalog fingerprint (key position 2).  Such entries could never
@@ -391,6 +412,7 @@ class PlanCache:
             "misses": self.misses,
             "evictions": self.evictions,
             "invalidations": self.invalidations,
+            "quarantined": self.quarantined,
             "hit_rate": (self.hits / total) if total else 0.0,
             "bytes": nbytes,
             "saved_s": self.saved_s,

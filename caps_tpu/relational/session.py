@@ -11,7 +11,8 @@ import abc
 import contextlib
 import hashlib
 import logging
-from typing import Any, Dict, List, Mapping, Optional, Tuple
+import threading
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 
 logger = logging.getLogger("caps_tpu")
 
@@ -48,6 +49,41 @@ from caps_tpu.serve.deadline import cancel_scope, checkpoint
 class NondeterministicResultError(RuntimeError):
     """Raised by the determinism check (EngineConfig.determinism_check)
     when a replayed query yields a different result multiset."""
+
+
+# -- degraded execution (failure containment, caps_tpu/serve/) --------------
+#
+# When the serving tier suspects shared cached state (a quarantined plan
+# entry, a poisoned fused memo), it re-executes the query in a degraded
+# mode that provably avoids that state: ``no_plan_cache`` bypasses the
+# session plan cache in BOTH directions (no lookup, no store — a
+# degraded run must not mutate shared state), ``no_fused`` additionally
+# forces per-operator eager execution on backends with a fused
+# record/replay executor.  The flags are per-THREAD: one worker's
+# degraded re-execution must not strip another worker's fast path.
+
+_degraded_tls = threading.local()
+
+
+def degraded_state() -> Tuple[bool, bool]:
+    """(no_plan_cache, no_fused) for the calling thread."""
+    return (getattr(_degraded_tls, "no_plan_cache", False),
+            getattr(_degraded_tls, "no_fused", False))
+
+
+@contextlib.contextmanager
+def degraded_execution(no_plan_cache: bool = True,
+                       no_fused: bool = False) -> Iterator[None]:
+    """Run queries on this thread in a degraded mode (see above).
+    Nests by OR-ing: an unfused region inside a replan region stays
+    unfused."""
+    prev = degraded_state()
+    _degraded_tls.no_plan_cache = prev[0] or no_plan_cache
+    _degraded_tls.no_fused = prev[1] or no_fused
+    try:
+        yield
+    finally:
+        _degraded_tls.no_plan_cache, _degraded_tls.no_fused = prev
 
 
 def result_digest(result: "CypherResult") -> str:
@@ -344,6 +380,19 @@ class RelationalCypherSession(CypherSession):
         self.metrics_registry.observe("session.batch_size", len(items))
         return out
 
+    def cypher_degraded(self, graph: RelationalCypherGraph, query: str,
+                        parameters: Optional[Mapping[str, Any]] = None, *,
+                        no_plan_cache: bool = True,
+                        no_fused: bool = False) -> CypherResult:
+        """Degraded re-execution for failure containment (the serving
+        tier's ladder — see :func:`degraded_execution`): bypass the plan
+        cache (fresh plan, nothing stored) and optionally force unfused
+        per-operator execution.  Correct results, none of the shared
+        cached state a poisoned entry could hide in."""
+        with degraded_execution(no_plan_cache=no_plan_cache,
+                                no_fused=no_fused):
+            return self.cypher_on_graph(graph, query, parameters)
+
     def cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
                         parameters: Optional[Mapping[str, Any]] = None
                         ) -> CypherResult:
@@ -508,8 +557,9 @@ class RelationalCypherSession(CypherSession):
         params = dict(parameters or {})
         tracer = self.tracer
 
+        no_plan_cache, _no_fused = degraded_state()
         cache_key: Optional[Tuple] = None
-        if self.plan_cache.enabled:
+        if self.plan_cache.enabled and not no_plan_cache:
             cache_key = self._plan_cache_key(graph, query, params)
             if cache_key is not None:
                 cached = self.plan_cache.lookup(cache_key, params)

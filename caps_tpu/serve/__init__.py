@@ -11,8 +11,14 @@ The layer between many client threads and one engine session
     serve/admission.py  bounded priority queue: admit or shed, never
                         queue unboundedly; graceful drain
     serve/batcher.py    micro-batching of plan-cache-compatible requests
+    serve/failure.py    failure taxonomy: classify(exc) ->
+                        TRANSIENT | POISONED_PLAN | FATAL
+    serve/retry.py      RetryPolicy: deadline-charged backoff with
+                        deterministic jitter
+    serve/breaker.py    per-plan-family circuit breakers (quarantine +
+                        degraded-ladder gating, health summary)
     serve/server.py     QueryServer: worker pool, one serialized device
-                        stream, serve.* metrics
+                        stream, serve.* metrics, containment ladder
 
 Engine hooks this package owns: ``RelationalCypherSession.cypher_batch``
 (one batched pass over a cached plan), the deadline checkpoints in
@@ -25,9 +31,10 @@ relational layer never pulls in the whole tier.
 """
 from caps_tpu.serve.deadline import (CancelScope, cancel_scope, checkpoint,
                                      current_scope)
-from caps_tpu.serve.errors import (Cancelled, CancellationError,
-                                   DeadlineExceeded, Overloaded, ServeError,
-                                   ServerClosed)
+from caps_tpu.serve.errors import (Cancelled, CancellationError, CircuitOpen,
+                                   DeadlineExceeded, Overloaded, QueryFailed,
+                                   ServeError, ServerClosed, WaitTimeout)
+from caps_tpu.serve.failure import FATAL, POISONED_PLAN, TRANSIENT, classify
 
 _LAZY = {
     "QueryServer": "caps_tpu.serve.server",
@@ -39,12 +46,16 @@ _LAZY = {
     "Request": "caps_tpu.serve.request",
     "INTERACTIVE": "caps_tpu.serve.request",
     "BATCH": "caps_tpu.serve.request",
+    "RetryPolicy": "caps_tpu.serve.retry",
+    "CircuitBreaker": "caps_tpu.serve.breaker",
 }
 
 __all__ = [
     "ServeError", "ServerClosed", "Overloaded", "CancellationError",
-    "DeadlineExceeded", "Cancelled", "CancelScope", "cancel_scope",
-    "checkpoint", "current_scope", *sorted(_LAZY),
+    "DeadlineExceeded", "Cancelled", "CircuitOpen", "QueryFailed",
+    "WaitTimeout", "CancelScope", "cancel_scope", "checkpoint",
+    "current_scope", "classify", "TRANSIENT", "POISONED_PLAN", "FATAL",
+    *sorted(_LAZY),
 ]
 
 

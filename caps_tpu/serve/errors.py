@@ -9,11 +9,16 @@ stall from a device stall from queue pressure.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 
 class ServeError(RuntimeError):
-    """Base class for all serving-tier errors."""
+    """Base class for all serving-tier errors.
+
+    Invariant (enforced by ``scripts/check_serve_errors.py``): every
+    exception *constructed and raised* inside ``caps_tpu/serve/``
+    inherits from this class, so a client needs exactly one except
+    clause to catch everything the serving tier itself can signal."""
 
 
 class ServerClosed(ServeError):
@@ -33,6 +38,43 @@ class Overloaded(ServeError):
         self.retry_after_s = retry_after_s
         self.queue_depth = queue_depth
         self.priority = priority
+
+
+class WaitTimeout(ServeError, TimeoutError):
+    """A *client wait* on a handle ran out (``QueryHandle.result(timeout)``)
+    — says nothing about the request itself, which is still in flight.
+    Subclasses :class:`TimeoutError` so pre-existing ``except
+    TimeoutError`` call sites keep working."""
+
+
+class QueryFailed(ServeError):
+    """Terminal failure after the server exhausted its containment
+    ladder (transient retries, plan quarantine, degraded re-execution).
+
+    ``attempts`` is the machine-readable attempt history — one dict per
+    execution with the mode it ran in (``fused`` / ``replan`` /
+    ``unfused``), the error type/classification observed, and any backoff
+    charged — so a client (or the soak test) can reconstruct exactly
+    what the server tried.  ``retry_after_s`` reuses the
+    :class:`Overloaded` hint semantics: when the give-up was budget- or
+    breaker-driven, it is the earliest time a retry could behave
+    differently (0.0 = retrying will not help)."""
+
+    def __init__(self, message: str, attempts: Tuple[dict, ...] = (),
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.attempts = tuple(attempts)
+        self.retry_after_s = retry_after_s
+
+
+class CircuitOpen(QueryFailed):
+    """Fast-fail: this request's plan family tripped its circuit breaker
+    and the cooldown has not elapsed — the server refuses to burn device
+    time on a family that is failing deterministically.  ``retry_after_s``
+    is the remaining cooldown (after it, one half-open trial runs)."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message, attempts=(), retry_after_s=retry_after_s)
 
 
 class CancellationError(ServeError):

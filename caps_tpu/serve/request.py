@@ -15,7 +15,7 @@ import threading
 from typing import Any, Dict, Mapping, Optional, Tuple
 
 from caps_tpu.serve.deadline import CancelScope
-from caps_tpu.serve.errors import Cancelled
+from caps_tpu.serve.errors import Cancelled, WaitTimeout
 
 #: Priority classes (lower value = served first).  INTERACTIVE is the
 #: latency-sensitive default; BATCH work queues behind it and is the
@@ -67,7 +67,7 @@ class QueryHandle:
     def exception(self, timeout: Optional[float] = None
                   ) -> Optional[BaseException]:
         if not self._done.wait(timeout):
-            raise TimeoutError("request not complete")
+            raise WaitTimeout("request not complete")
         return self._exception
 
     def result(self, timeout: Optional[float] = None) -> Any:
@@ -75,7 +75,7 @@ class QueryHandle:
         ``timeout`` bounds the *wait*, not the query (that is what the
         request's deadline is for)."""
         if not self._done.wait(timeout):
-            raise TimeoutError("request not complete")
+            raise WaitTimeout("request not complete")
         if self._exception is not None:
             raise self._exception
         return self._result

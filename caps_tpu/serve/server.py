@@ -39,15 +39,25 @@ from caps_tpu.obs import clock
 from caps_tpu.serve import batcher as _batcher
 from caps_tpu.serve.admission import AdmissionController
 from caps_tpu.serve.batcher import MicroBatcher
+from caps_tpu.serve.breaker import REJECT, TRIAL, CircuitBreaker
 from caps_tpu.serve.deadline import CancelScope, cancel_scope
-from caps_tpu.serve.errors import (Cancelled, CancellationError,
-                                   DeadlineExceeded, ServerClosed)
+from caps_tpu.serve.errors import (Cancelled, CancellationError, CircuitOpen,
+                                   DeadlineExceeded, QueryFailed,
+                                   ServerClosed)
+from caps_tpu.serve.failure import FATAL, TRANSIENT, classify
 from caps_tpu.serve.request import INTERACTIVE, QueryHandle, Request
+from caps_tpu.serve.retry import RetryPolicy
 
 _UNSET = object()
 
 #: batch-size histogram buckets (powers of two up to the queue bound)
 _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+#: degraded execution ladder (failure containment): 0 = the normal
+#: serving path (cached plan, fused TPU replay); 1 = plan-cache bypass —
+#: a fresh plan, fused execution re-records from scratch; 2 = fresh plan
+#: AND per-operator unfused execution (no shared cached state at all).
+_LADDER = ("fused", "replan", "unfused")
 
 _session_locks_guard = threading.Lock()
 
@@ -87,6 +97,15 @@ class ServerConfig:
     default_priority: int = INTERACTIVE
     #: materialize rows on the worker (handle.rows() is then free)
     materialize: bool = True
+    #: transient-error retry (serve/retry.py): exponential backoff with
+    #: deterministic jitter, charged against the request's deadline
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    #: consecutive request-level failures (whole containment ladder
+    #: exhausted) before a plan family's circuit breaker opens
+    breaker_threshold: int = 3
+    #: seconds an open breaker fast-fails a family before letting one
+    #: half-open trial through
+    breaker_cooldown_s: float = 5.0
 
 
 class QueryServer:
@@ -114,6 +133,10 @@ class QueryServer:
         self.batcher = MicroBatcher(self.admission,
                                     max_batch=self.config.max_batch,
                                     window_s=self.config.batch_window_s)
+        self.retry_policy = self.config.retry or RetryPolicy()
+        self.breaker = CircuitBreaker(
+            registry, failure_threshold=self.config.breaker_threshold,
+            cooldown_s=self.config.breaker_cooldown_s)
         # ONE device stream: execution is serialized; workers overlap
         # on admission, timeout handling, and materialization.  The
         # lock is per-SESSION (shared by every server over it).
@@ -123,6 +146,9 @@ class QueryServer:
         self._cancelled = registry.counter("serve.cancelled")
         self._deadline_exceeded = registry.counter("serve.deadline_exceeded")
         self._batches = registry.counter("serve.batches")
+        self._retries = registry.counter("serve.retries")
+        self._quarantines = registry.counter("serve.quarantined")
+        self._degraded_runs = registry.counter("serve.degraded_exec")
         self._batch_hist = registry.histogram("serve.batch_size",
                                               buckets=_BATCH_BUCKETS)
         self._latency = registry.histogram("serve.latency_s")
@@ -218,10 +244,25 @@ class QueryServer:
         return self.submit(query, parameters, **kwargs).result()
 
     def stats(self) -> Dict[str, Any]:
-        """The ``serve.*`` slice of the metrics registry, unprefixed."""
+        """The ``serve.*`` slice of the metrics registry, unprefixed,
+        plus the failure-containment summary (``health``, per-family
+        breaker states)."""
         snap = self._registry.snapshot()
-        return {k[len("serve."):]: v for k, v in snap.items()
-                if k.startswith("serve.")}
+        out = {k[len("serve."):]: v for k, v in snap.items()
+               if k.startswith("serve.")}
+        out["health"] = self.health()
+        out["breakers"] = self.breaker.summary()
+        return out
+
+    def health(self) -> str:
+        """One-word serving health: ``healthy`` (all families closed),
+        ``degraded`` (>= 1 family's breaker open / half-open — those
+        families fast-fail or probe while everything else serves), or
+        ``lame-duck`` (shutdown began: draining, accepting nothing
+        new)."""
+        if self.admission.closed:
+            return "lame-duck"
+        return "degraded" if self.breaker.open_count() else "healthy"
 
     # -- worker pool ---------------------------------------------------
 
@@ -273,10 +314,68 @@ class QueryServer:
             live.append(req)
         return live
 
+    def _family(self, req: Request):
+        """The circuit breaker's key: the plan-cache key family the
+        micro-batcher groups by, or a per-query fallback for requests
+        that can never batch (EXPLAIN/PROFILE, uncacheable graphs)."""
+        if req.batch_key is not None:
+            return req.batch_key
+        return ("solo", req.mode, req.query)
+
     def _execute_batch(self, batch: List[Request]) -> None:
         live = self._admit_for_execution(batch)
         if not live:
             return
+        family = self._family(live[0])
+        verdict, retry_after = self.breaker.admit(family)
+        if verdict == REJECT:
+            # open breaker: fast-fail the whole family without touching
+            # the device — a FRESH exception per member (handles must
+            # never share one mutable error object)
+            for req in live:
+                self._finish(req, CircuitOpen(
+                    f"plan family circuit breaker is open "
+                    f"(retry after {retry_after:.3f}s)",
+                    retry_after_s=retry_after))
+            return
+        if verdict == TRIAL:
+            # half-open: exactly ONE probe executes (degraded replan —
+            # the cached entry was quarantined when the breaker opened).
+            # Its verdict decides the rest of the batch: success closes
+            # the breaker and the siblings serve normally below; failure
+            # re-opens it and the siblings fast-fail.  A probe that was
+            # cancelled / expired decided NOTHING — the next member
+            # becomes the probe instead of being failed with a
+            # breaker error it never earned.
+            healed = False
+            while live:
+                probe, live = live[0], live[1:]
+                probe.handle.info["batch_size"] = 1
+                self._batches.inc()
+                self._batch_hist.observe(1)
+                outcome = self._execute_single(probe, level=1)
+                if isinstance(outcome, BaseException):
+                    outcome = self._recover(probe, outcome, 1)
+                if isinstance(outcome, CancellationError):
+                    self.breaker.abort_trial(family)
+                    self._finish(probe, outcome)
+                    continue
+                if isinstance(outcome, BaseException):
+                    self.breaker.record_failure(family, outcome)
+                    self._finish(probe, outcome)
+                    for req in live:
+                        self._finish(req, CircuitOpen(
+                            f"plan family circuit breaker re-opened by a "
+                            f"failed half-open trial (retry after "
+                            f"{self.breaker.cooldown_s:.3f}s)",
+                            retry_after_s=self.breaker.cooldown_s))
+                    return
+                self.breaker.record_success(family)
+                self._finish(probe, outcome)
+                healed = True
+                break
+            if not live or not healed:
+                return
         n = len(live)
         self._batches.inc()
         self._batch_hist.observe(n)
@@ -302,8 +401,164 @@ class QueryServer:
             exec_s = clock.now() - t0
         # feed the admission controller's retry_after estimator
         self.admission.observe_service(exec_s / n)
+        # successful members complete FIRST: a failed sibling's recovery
+        # (backoff sleeps + serialized re-executions) must not sit
+        # between a finished result and the client waiting on it
+        pending = []
         for req, outcome in zip(live, outcomes):
+            if isinstance(outcome, BaseException):
+                pending.append((req, outcome))
+            else:
+                self.breaker.record_success(family)
+                self._finish(req, outcome)
+        for req, exc in pending:
+            outcome = self._recover(req, exc, 0)
+            # breaker bookkeeping on the request's FINAL outcome;
+            # cancellation/deadline expiry is the budget's verdict, not
+            # the family's
+            if isinstance(outcome, BaseException):
+                if not isinstance(outcome, CancellationError):
+                    if self.breaker.record_failure(family, outcome) \
+                            and not req.handle.info.get("quarantined"):
+                        # this failure tripped the family open: evict its
+                        # shared cached state so the half-open trial (and
+                        # the eventual recovery) re-plans from scratch —
+                        # unless the recovery ladder already did
+                        self._quarantine(req)
+            else:
+                self.breaker.record_success(family)
             self._finish(req, outcome)
+
+    # -- failure containment (retry / quarantine / degraded ladder) ----
+
+    def _recover(self, req: Request, exc: BaseException, level: int) -> Any:
+        """Containment ladder for ONE failed request: classify the
+        error, then either return it (fatal / cancelled), retry the same
+        path with deadline-charged backoff (transient), or quarantine
+        the cached plan and climb the degraded ladder (poisoned).
+        Returns the final outcome — a CypherResult or the exception to
+        complete the handle with.  Never raises."""
+        policy = self.retry_policy
+        attempts = [self._attempt_entry(exc, level)]
+        executions = 1
+        current: BaseException = exc
+        while True:
+            if isinstance(current, CancellationError):
+                break  # the budget's verdict stands
+            kind = attempts[-1]["classified"]
+            if kind == FATAL:
+                break
+            if kind == TRANSIENT:
+                if executions >= policy.max_attempts:
+                    current = QueryFailed(
+                        f"still failing transiently after {executions} "
+                        f"attempts: {type(current).__name__}: {current}",
+                        attempts=tuple(attempts),
+                        retry_after_s=policy.backoff_s(executions,
+                                                       req.request_id))
+                    break
+                backoff = policy.backoff_s(executions, req.request_id)
+                if not policy.budget_allows(req.scope.remaining(), backoff):
+                    # a retry never fires when the remaining deadline
+                    # budget cannot cover the next backoff: give up NOW
+                    # with the backoff as the client's retry hint
+                    current = QueryFailed(
+                        f"transient failure, but remaining deadline "
+                        f"budget < next backoff ({backoff:.3f}s): "
+                        f"{type(current).__name__}: {current}",
+                        attempts=tuple(attempts), retry_after_s=backoff)
+                    break
+                attempts[-1]["backoff_s"] = backoff
+                self._retries.inc()
+                tracer = self.session.tracer
+                if tracer.enabled:
+                    tracer.event("retry.attempt", attempt=executions,
+                                 backoff_s=backoff, mode=_LADDER[level],
+                                 error=type(current).__name__)
+                policy.sleep(backoff)
+            else:  # POISONED_PLAN: quarantine once, then climb the ladder
+                if level >= len(_LADDER) - 1:
+                    current = QueryFailed(
+                        f"degraded ladder exhausted after {executions} "
+                        f"attempts: {type(current).__name__}: {current}",
+                        attempts=tuple(attempts))
+                    break
+                if level == 0:
+                    self._quarantine(req)
+                level += 1
+                self._degraded_runs.inc()
+            executions += 1
+            outcome = self._execute_single(req, level)
+            if not isinstance(outcome, BaseException):
+                attempts.append({"mode": _LADDER[level], "ok": True})
+                req.handle.info["attempts"] = attempts
+                return outcome
+            attempts.append(self._attempt_entry(outcome, level))
+            current = outcome
+        req.handle.info["attempts"] = attempts
+        return current
+
+    @staticmethod
+    def _attempt_entry(exc: BaseException, level: int) -> Dict[str, Any]:
+        """One attempt-history record.  A fresh dict per attempt per
+        request — failure context lives HERE, never as mutations of the
+        exception object (which a badly-behaved injector might share
+        across batch members)."""
+        entry = {"mode": _LADDER[level], "error": type(exc).__name__,
+                 "message": str(exc)[:200], "classified": classify(exc)}
+        failed_op = getattr(exc, "caps_failed_op", None)
+        if failed_op is not None:
+            entry["op"] = failed_op
+        return entry
+
+    def _execute_single(self, req: Request, level: int) -> Any:
+        """One (re-)execution of a single request at a ladder level.
+        Returns the result or the raised exception."""
+        with self._exec_lock:
+            t0 = clock.now()
+            try:
+                with cancel_scope(req.scope):
+                    if level == 0:
+                        return self.session.cypher_on_graph(
+                            req.graph, req.query, req.params)
+                    return self.session.cypher_degraded(
+                        req.graph, req.query, req.params,
+                        no_plan_cache=True, no_fused=(level >= 2))
+            except BaseException as ex:
+                return ex
+            finally:
+                self.admission.observe_service(clock.now() - t0)
+
+    def _quarantine(self, req: Request) -> None:
+        """Evict the request family's shared cached state: the session
+        plan-cache entry (relational/plan_cache.py) and, on the TPU
+        backend, the fused size memos (backends/tpu/fused.py) — a
+        poisoned entry must not keep failing every future hit.
+        Stamped on the handle so one request quarantines at most once
+        (the ladder and a breaker trip must not double-count)."""
+        req.handle.info["quarantined"] = True
+        self._quarantines.inc()
+        session = self.session
+        try:
+            key_fn = getattr(session, "_plan_cache_key", None)
+            if key_fn is not None:
+                key = key_fn(req.graph, req.query, req.params)
+                if key is not None:
+                    session.plan_cache.quarantine(key)
+        except Exception:  # pragma: no cover — containment must not fail
+            pass
+        fused = getattr(session, "fused", None)
+        if fused is not None:
+            try:
+                # under the exec lock: the memo maps must not shrink
+                # under an in-flight fused run on another worker
+                with self._exec_lock:
+                    fused.forget(req.graph, req.query)
+            except Exception:  # pragma: no cover
+                pass
+        tracer = session.tracer
+        if tracer.enabled:
+            tracer.event("plan.quarantined", query=req.query)
 
     def _finish(self, req: Request, outcome: Any) -> None:
         """Materialize (deadline-checked) and complete one handle."""

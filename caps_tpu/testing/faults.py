@@ -1,22 +1,210 @@
-"""Test-only fault injection.
+"""Composable, thread-safe fault injection.
 
 SURVEY.md §5.3: the reference inherits failure detection from Spark
 (lineage re-execution, executor blacklisting) and ships no fault-injection
 tests of its own; single-controller JAX has no task retry, so our
-equivalent machinery is (a) deterministic replay + digest comparison
-(``EngineConfig.determinism_check`` / ``result_digest``) and (b) this
-module: :func:`corrupt_shard` silently damages one shard's buffers on
-ingest so tests can prove the detection machinery notices, and
-:func:`slow_operator` injects a deterministic delay into one relational
-operator so deadline/cancellation paths (``caps_tpu/serve/``) are
-testable without sleep-and-hope timing races.
+failure-containment layer (``caps_tpu/serve/``: transient retry, plan
+quarantine, degraded execution) needs faults it can practice against.
+This module provides them:
+
+* :func:`failing_operator` — raise a chosen exception from one
+  relational operator's ``_compute``, transiently (``n_times=1`` fails
+  the next execution then heals) or permanently (``n_times=None``);
+* :func:`slow_operator` — deterministic per-operator delay (deadline /
+  cancellation tests without sleep-and-hope timing);
+* :func:`device_oom` — a realistic ``XlaRuntimeError``-shaped
+  ``RESOURCE_EXHAUSTED``, injected at an operator boundary or into
+  ingest placement;
+* :func:`flaky_ingest` — fail the first N table ingests of a session
+  with a transient device error;
+* :func:`corrupt_shard` — silent data damage on one shard (digest /
+  parity detection tests);
+* :class:`FaultPlan` — compose any of the above into one context
+  manager.
+
+All operator-level faults route through ONE locked patch point
+(:class:`_OperatorPatch`): each operator class is monkey-patched at most
+once, active hooks stack in installation order, nesting and concurrent
+``with`` blocks from different threads are safe, and the original
+``_compute`` is restored exactly when the last hook leaves.  Injection
+counts land in the process-global MetricsRegistry under
+``faults.injected.*`` so a soak run can assert how much damage was
+actually dealt.
+
+Exception freshness: injectors construct a NEW exception object per
+injection (an instance argument is treated as a template and re-built
+via ``type(exc)(*exc.args)``).  Two batch members hit by "the same"
+fault must never share one mutable error object — the serving tier's
+per-member isolation contract depends on it (tests/test_faults.py).
 """
 from __future__ import annotations
 
 import contextlib
-import time
+import threading
+import warnings
+from typing import Callable, Dict, List, Optional, Type, Union
 
 import jax.numpy as jnp
+
+from caps_tpu.obs import clock
+from caps_tpu.obs.metrics import global_registry
+
+
+def xla_runtime_error_class() -> Type[BaseException]:
+    """The real jaxlib ``XlaRuntimeError`` when available (so injected
+    device faults are indistinguishable from genuine ones), else a
+    same-named stub."""
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+        return XlaRuntimeError
+    except Exception:  # pragma: no cover — stub for jaxlib-less installs
+        class XlaRuntimeError(RuntimeError):
+            pass
+        return XlaRuntimeError
+
+
+def make_oom(note: str = "") -> BaseException:
+    """A fresh ``RESOURCE_EXHAUSTED`` in the exact shape the TPU runtime
+    raises it (message prefix included — serve/failure.py classifies by
+    those status words)."""
+    cls = xla_runtime_error_class()
+    return cls("RESOURCE_EXHAUSTED: Attempting to allocate 2.50G. That was"
+               " not possible. There are 1.25G free."
+               + (f" [{note}]" if note else ""))
+
+
+def _resolve_operator(op_name: str) -> type:
+    from caps_tpu.relational import ops as R
+    cls_name = op_name if op_name.endswith("Op") else op_name + "Op"
+    cls = getattr(R, cls_name, None)
+    if cls is None or not isinstance(cls, type) \
+            or not issubclass(cls, R.RelationalOperator):
+        raise ValueError(f"unknown relational operator {op_name!r}")
+    return cls
+
+
+ExcSpec = Union[BaseException, Type[BaseException],
+                Callable[[], BaseException], None]
+
+
+def _fresh_exception(spec: ExcSpec) -> BaseException:
+    """Build a NEW exception object from a spec (see module docstring)."""
+    if spec is None:
+        return make_oom()
+    if isinstance(spec, BaseException):
+        try:
+            return type(spec)(*spec.args)
+        except Exception:
+            return type(spec)(str(spec))
+    return spec()  # class or zero-arg factory
+
+
+class _Budget:
+    """Locked injection schedule shared across threads: fire on every
+    ``every_n``-th eligible invocation (1 = every one), at most
+    ``n_times`` total (None = unlimited — a permanent fault).
+
+    ``every_n > 1`` is the deterministic "~1/N of executions fail once"
+    shape the soak acceptance uses: an immediate retry is invocation
+    k+1, never again on the every-N boundary, so a single-shot retry
+    always heals — no luck involved."""
+
+    def __init__(self, n_times: Optional[int], every_n: int = 1):
+        self._n = n_times
+        self._every = max(1, int(every_n))
+        self._lock = threading.Lock()
+        self._calls = 0
+        self.injected = 0
+
+    def take(self) -> bool:
+        with self._lock:
+            if self._n is not None and self._n <= 0:
+                return False
+            self._calls += 1
+            if (self._calls - 1) % self._every:
+                return False
+            if self._n is not None:
+                self._n -= 1
+            self.injected += 1
+            return True
+
+
+class _OperatorPatch:
+    """The ONE patch point for relational-operator fault hooks.
+
+    Each operator class's ``_compute`` is replaced (at most once, under
+    the lock) by a dispatcher that runs the class's active hooks in
+    installation order and then calls the original.  Hooks are plain
+    callables ``hook(op_instance) -> None`` that may sleep or raise.
+    When a class's last hook is removed its original ``_compute`` is
+    restored — nothing stays patched after the outermost ``with``
+    exits, however the contexts were nested or threaded."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._originals: Dict[type, Callable] = {}
+        self._hooks: Dict[type, List[Callable]] = {}
+
+    def _dispatcher(self, cls: type) -> Callable:
+        def _compute_with_hooks(op_self):
+            with self._lock:
+                hooks = list(self._hooks.get(cls, ()))
+                orig = self._originals.get(cls)
+            for hook in hooks:  # hooks run OUTSIDE the lock: they sleep
+                hook(op_self)
+            if orig is None:  # pragma: no cover — unpatch raced us; the
+                return cls._compute(op_self)  # restored original is live
+            return orig(op_self)
+        return _compute_with_hooks
+
+    @contextlib.contextmanager
+    def hooked(self, cls: type, hook: Callable):
+        with self._lock:
+            if cls not in self._originals:
+                # the class's own _compute if it defines one, else the
+                # inherited one (restored verbatim either way)
+                self._originals[cls] = cls.__dict__.get(
+                    "_compute", cls._compute)
+                cls._compute = self._dispatcher(cls)
+            self._hooks.setdefault(cls, []).append(hook)
+        try:
+            yield
+        finally:
+            with self._lock:
+                hooks = self._hooks.get(cls, [])
+                if hook in hooks:
+                    hooks.remove(hook)
+                if not hooks:
+                    self._hooks.pop(cls, None)
+                    orig = self._originals.pop(cls, None)
+                    if orig is not None:
+                        cls._compute = orig
+
+
+#: process-wide patch point (module-level: every FaultPlan and bare
+#: context manager composes through the same locks)
+OPERATOR_PATCH = _OperatorPatch()
+
+
+def _count_injection(name: str) -> None:
+    global_registry().counter(f"faults.injected.{name}").inc()
+
+
+@contextlib.contextmanager
+def _patched_place_column(backend, wrap: Callable[[Callable], Callable]):
+    """The ONE install/restore path for ingest-placement faults
+    (flaky_ingest, corrupt_shard): replaces ``backend.place_column``
+    with ``wrap(original)`` under the shared fault lock and restores the
+    captured original on exit.  Nesting is LIFO (each context captures
+    whatever is installed when it enters, like the operator hooks)."""
+    with OPERATOR_PATCH._lock:
+        orig = backend.place_column
+        backend.place_column = wrap(orig)
+    try:
+        yield
+    finally:
+        with OPERATOR_PATCH._lock:
+            backend.place_column = orig
 
 
 @contextlib.contextmanager
@@ -31,23 +219,91 @@ def slow_operator(op_name: str, delay_s: float):
     operator boundary's checkpoint raises ``DeadlineExceeded`` with
     ``phase="execute"``.  No test ever has to guess how long a real
     query takes."""
-    from caps_tpu.relational import ops as R
-    cls_name = op_name if op_name.endswith("Op") else op_name + "Op"
-    cls = getattr(R, cls_name, None)
-    if cls is None or not isinstance(cls, type) \
-            or not issubclass(cls, R.RelationalOperator):
-        raise ValueError(f"unknown relational operator {op_name!r}")
-    orig = cls._compute
+    cls = _resolve_operator(op_name)
 
-    def slowed(self):
-        time.sleep(delay_s)
-        return orig(self)
+    def hook(_op):
+        _count_injection("slow_operator")
+        clock.sleep(delay_s)
 
-    cls._compute = slowed
-    try:
+    with OPERATOR_PATCH.hooked(cls, hook):
         yield
-    finally:
-        cls._compute = orig
+
+
+@contextlib.contextmanager
+def failing_operator(op_name: str, exc: ExcSpec = None,
+                     n_times: Optional[int] = None, every_n: int = 1):
+    """While active, the named operator's ``_compute`` raises before
+    computing — a FRESH exception per injection, built from ``exc`` (an
+    exception template, an exception class, a zero-arg factory, or None
+    for a realistic device OOM).
+
+    ``n_times`` bounds the total injections across all threads:
+    ``n_times=1`` is the canonical transient fault (fails once, then
+    heals — the retry path must succeed), ``n_times=None`` is a
+    permanent fault (the circuit-breaker path must trip).  ``every_n``
+    spaces injections out deterministically — ``every_n=5`` fails every
+    5th execution, i.e. ~20% of requests fail exactly once and every
+    single retry lands between boundaries and heals (the soak
+    acceptance's fault shape).  Yields the budget object so tests can
+    read ``.injected``."""
+    cls = _resolve_operator(op_name)
+    budget = _Budget(n_times, every_n)
+
+    def hook(_op):
+        if budget.take():
+            _count_injection("failing_operator")
+            raise _fresh_exception(exc)
+
+    with OPERATOR_PATCH.hooked(cls, hook):
+        yield budget
+
+
+@contextlib.contextmanager
+def device_oom(phase: str = "execute", op_name: str = "Scan",
+               session=None, n_times: Optional[int] = 1):
+    """A realistic device ``RESOURCE_EXHAUSTED`` (XlaRuntimeError-shaped,
+    classified TRANSIENT by serve/failure.py).
+
+    ``phase="execute"`` raises from the named operator's compute (any
+    query touching it); ``phase="ingest"`` raises from ``session``'s
+    column placement — ingest faults need the session whose backend is
+    being damaged.  Yields the injection budget."""
+    if phase == "execute":
+        with failing_operator(op_name, make_oom, n_times=n_times) as budget:
+            yield budget
+        return
+    if phase != "ingest":
+        raise ValueError(f"device_oom phase must be 'execute' or "
+                         f"'ingest', got {phase!r}")
+    if session is None:
+        raise ValueError("device_oom(phase='ingest') needs session=")
+    with flaky_ingest(session, n_times=n_times, exc=make_oom) as budget:
+        yield budget
+
+
+@contextlib.contextmanager
+def flaky_ingest(session, n_times: Optional[int] = 1, exc: ExcSpec = None):
+    """Fail the session's next ``n_times`` device column placements with
+    a transient device error (default: the realistic OOM).  The engine's
+    containment obligations under this fault: the ingest raises cleanly,
+    and the string pool rolls back to its pre-ingest size so fused
+    replayability is not silently invalidated (backends/tpu/table.py).
+    Yields the injection budget."""
+    backend = getattr(session, "backend", None)
+    if backend is None or not hasattr(backend, "place_column"):
+        raise ValueError("flaky_ingest needs a device-backed session")
+    budget = _Budget(n_times)
+
+    def wrap(orig):
+        def poisoned(col):
+            if budget.take():
+                _count_injection("flaky_ingest")
+                raise _fresh_exception(exc)
+            return orig(col)
+        return poisoned
+
+    with _patched_place_column(backend, wrap):
+        yield budget
 
 
 @contextlib.contextmanager
@@ -55,29 +311,94 @@ def corrupt_shard(session, shard: int = 0, flip_bits: int = 1):
     """While active, every *data* buffer placed on the backend's mesh gets
     ``flip_bits`` added to the rows landing on ``shard`` (validity masks
     are left intact — the corruption is silent, like real bit damage).
-    Only affects tables ingested inside the ``with`` block."""
+    Only affects tables ingested inside the ``with`` block.
+
+    A column the injector CANNOT damage (row count not divisible by the
+    shard count, or a bool dtype where "+1" is not bit damage) is
+    skipped with a warning, and if NOTHING was corrupted by the time the
+    block exits the context raises — a fault test that injected no fault
+    must fail loudly, not pass vacuously."""
     backend = session.backend
     if backend.mesh is None:
         raise ValueError("corrupt_shard needs a sharded session "
                          "(EngineConfig.mesh_shape)")
     n_shards = backend.mesh.devices.size
-    orig = backend.place_column
+    counts = {"corrupted": 0, "skipped": 0}
 
-    def poisoned(col):
-        n = col.data.shape[0]
-        if n % n_shards == 0 and col.data.dtype != jnp.bool_:
-            rows = n // n_shards
-            lo, hi = shard * rows, (shard + 1) * rows
-            idx = jnp.arange(n)
-            in_shard = (idx >= lo) & (idx < hi)
-            bump = jnp.asarray(flip_bits, col.data.dtype)
-            col = type(col)(col.kind,
-                            jnp.where(in_shard, col.data + bump, col.data),
-                            col.valid, col.ctype, col.lens)
-        return orig(col)
+    def wrap(orig):
+        def poisoned(col):
+            n = col.data.shape[0]
+            if n % n_shards == 0 and col.data.dtype != jnp.bool_:
+                rows = n // n_shards
+                lo, hi = shard * rows, (shard + 1) * rows
+                idx = jnp.arange(n)
+                in_shard = (idx >= lo) & (idx < hi)
+                bump = jnp.asarray(flip_bits, col.data.dtype)
+                col = type(col)(col.kind,
+                                jnp.where(in_shard, col.data + bump,
+                                          col.data),
+                                col.valid, col.ctype, col.lens)
+                counts["corrupted"] += 1
+                _count_injection("corrupt_shard")
+            else:
+                counts["skipped"] += 1
+                reason = ("bool dtype" if col.data.dtype == jnp.bool_
+                          else f"{n} rows not divisible by "
+                               f"{n_shards} shards")
+                warnings.warn(f"corrupt_shard skipped a column ({reason}) "
+                              f"— this column was placed UNDAMAGED",
+                              stacklevel=2)
+            return orig(col)
+        return poisoned
 
-    backend.place_column = poisoned
-    try:
-        yield
-    finally:
-        backend.place_column = orig
+    with _patched_place_column(backend, wrap):
+        yield counts
+    # only reached on a CLEAN exit (an exception unwinding the body
+    # propagates above and must not be masked by the vacuity check)
+    if counts["corrupted"] == 0:
+        raise RuntimeError(
+            "corrupt_shard corrupted NOTHING "
+            f"({counts['skipped']} column(s) skipped) — the fault "
+            "test would pass vacuously; ingest a divisible-row, "
+            "non-bool column inside the block")
+
+
+class FaultPlan:
+    """Compose several faults into one context manager.
+
+    >>> plan = FaultPlan(slow_operator("Filter", 0.01),
+    ...                  failing_operator("Scan", n_times=1))
+    >>> with plan:
+    ...     ...  # both faults active, LIFO-unwound on exit
+
+    ``add()`` appends before (not during) activation; plans nest freely
+    with each other and with bare fault context managers — every
+    operator hook goes through the same locked patch point."""
+
+    def __init__(self, *faults):
+        self._faults = list(faults)
+        self._stack: Optional[contextlib.ExitStack] = None
+
+    def add(self, fault) -> "FaultPlan":
+        if self._stack is not None:
+            raise RuntimeError("FaultPlan is active; build a nested "
+                               "FaultPlan instead")
+        self._faults.append(fault)
+        return self
+
+    def __enter__(self) -> "FaultPlan":
+        if self._stack is not None:
+            raise RuntimeError("FaultPlan is not re-entrant")
+        stack = contextlib.ExitStack()
+        try:
+            for fault in self._faults:
+                stack.enter_context(fault)
+        except BaseException:
+            stack.close()
+            raise
+        self._stack = stack
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        stack, self._stack = self._stack, None
+        return stack.__exit__(*exc)

@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Lint: every exception constructed and raised inside ``caps_tpu/serve/``
+inherits :class:`caps_tpu.serve.errors.ServeError`.
+
+The serving tier's client contract (docs/guide.md "Failure handling") is
+that ONE except clause — ``except ServeError`` — catches everything the
+tier itself can signal: shedding, deadlines, cancellation, retry
+give-ups, breaker fast-fails, wait timeouts.  A stray ``raise
+TimeoutError(...)`` silently breaks that contract for every client, so
+this script walks the AST of each ``caps_tpu/serve/*.py`` file, finds
+``raise SomeName(...)`` statements, resolves ``SomeName`` against the
+module's imported/defined names, and fails unless the resolved class
+subclasses ``ServeError``.
+
+Skipped (not statically checkable, and legitimately outside the
+contract): bare ``raise`` re-raises and ``raise some_variable`` — e.g.
+``QueryHandle.result`` re-raising the ENGINE's error, which is the
+client's query failing, not the serving tier signalling.
+
+Exit status: 0 clean, 1 with findings.  Run standalone or via CI.
+"""
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE = os.path.join(REPO, "caps_tpu", "serve")
+
+
+def _raised_names(tree: ast.AST):
+    """(lineno, name) for every ``raise Name(...)`` / ``raise Name``
+    with a plain-name callee.  Raises inside a ``__getattr__`` are
+    exempt: the module/attribute protocol REQUIRES AttributeError there
+    (it signals "name not exported", not a serving failure)."""
+    exempt = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "__getattr__":
+            exempt.update(id(n) for n in ast.walk(node))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise) or node.exc is None \
+                or id(node) in exempt:
+            continue
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        if isinstance(exc, ast.Name):
+            yield node.lineno, exc.id
+
+
+def findings():
+    sys.path.insert(0, REPO)
+    from caps_tpu.serve.errors import ServeError
+    out = []
+    for fname in sorted(os.listdir(SERVE)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(SERVE, fname)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        module = importlib.import_module(
+            f"caps_tpu.serve.{fname[:-3]}" if fname != "__init__.py"
+            else "caps_tpu.serve")
+        rel = os.path.relpath(path, REPO)
+        for lineno, name in _raised_names(tree):
+            obj = getattr(module, name, None)
+            if obj is None:
+                out.append(f"{rel}:{lineno}: raises unresolvable "
+                           f"name {name!r}")
+            elif not (isinstance(obj, type)
+                      and issubclass(obj, ServeError)):
+                out.append(f"{rel}:{lineno}: raises {name}, which does "
+                           f"not inherit ServeError")
+    return out
+
+
+def main() -> int:
+    bad = findings()
+    if bad:
+        print("serve/ raises non-ServeError exceptions "
+              "(clients must be able to catch ONE base type):")
+        for b in bad:
+            print(f"  {b}")
+        return 1
+    print("check_serve_errors: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
