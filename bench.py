@@ -29,6 +29,11 @@ Modes: ``python bench.py``           config 1 (2-hop foaf)
        ``python bench.py serve``     config 5 (QueryServer load: closed-
                                      and open-loop, latency percentiles,
                                      batch and shed behavior)
+       ``python bench.py serve --devices N``
+                                     config 7 (device fault domains:
+                                     serve QPS scaling 1 -> N replica
+                                     devices, then availability with one
+                                     device killed mid-run)
        ``python bench.py faults``    config 6 (serve under injected
                                      transient faults: availability,
                                      retry overhead, breaker behavior)
@@ -555,6 +560,155 @@ def run_serve_config(on_tpu: bool):
     _emit()
 
 
+def run_serve_devices_config(on_tpu: bool, devices_n: int):
+    """Benchmark config 7: device fault domains (``serve --devices N``).
+
+    Phase A measures closed-loop serve QPS (8 clients, prepared
+    parameterized 2-hop foaf) at 1 device and at N replica devices —
+    the scaling acceptance (``qps_by_devices``, ``qps_scaling``).  On
+    CPU the replicas are simulated devices (distinct sessions, distinct
+    compiled state — serve/devices.py); on TPU they pin to real
+    ``jax.devices()``.
+
+    Phase B re-runs the closed loop on the N-device server with one
+    device KILLED mid-run (``testing.faults.device_loss``): value =
+    availability — the fraction of requests resolving with correct
+    rows while the dead device quarantines and work redistributes to
+    the N-1 survivors.  Per-device health/quarantine counters are
+    reported from ``server.stats()['devices']``.
+    """
+    import threading as _th
+    import numpy as np
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.serve import (QueryServer, RetryPolicy, ServeError,
+                                ServerConfig)
+    from caps_tpu.testing.faults import device_loss
+
+    _result.update({"metric": "serve QPS by devices "
+                              "(no measurement completed)",
+                    "unit": "queries/s", "value": 0.0})
+    rng = np.random.RandomState(42)
+    if on_tpu:
+        n_people, n_edges = 200_000, 1_000_000
+    else:
+        n_people, n_edges = 100_000, 500_000
+    n_people = int(os.environ.get("BENCH_N_PEOPLE", n_people))
+    n_edges = int(os.environ.get("BENCH_N_EDGES", n_edges))
+    session = TPUCypherSession()
+    graph, src, dst, names = build_graph(session, n_people, n_edges,
+                                         10, rng)
+    # FOUR distinct plan families (the b.age constant differs in the
+    # query TEXT): same-family requests coalesce into one device's
+    # micro-batch, so a single family would let the 1-device server
+    # amortize everything into big batches and hide the parallelism —
+    # a mixed-family load is what N independent dispatch streams are
+    # FOR.  count(*) keeps materialization trivial; the two expand
+    # joins dominate, and that device compute runs GIL-free.
+    fams = [(f"MATCH (a:Person)-[:KNOWS]->(b) "
+             f"WHERE a.age > $min AND b.age < {85 - k} "
+             f"RETURN count(*) AS c") for k in range(4)]
+    binding = {"min": 30}
+    t0 = time.perf_counter()
+    exp = {q: graph.cypher(q, binding).records.to_maps() for q in fams}
+    _result["compile_s"] = round(time.perf_counter() - t0, 2)
+
+    clients = 8
+    per_client = int(os.environ.get("BENCH_SERVE_REQS", "12"))
+    total = clients * per_client
+
+    def closed_loop(server):
+        latencies, outcomes = [], []
+
+        def client(i):
+            for j in range(per_client):
+                q = fams[(i + j) % len(fams)]
+                try:
+                    h = server.submit(q, binding)
+                    rows = h.rows(timeout=180)
+                    outcomes.append("ok" if rows == exp[q] else "wrong")
+                    latencies.append(h.info["latency_s"])
+                except ServeError as ex:
+                    outcomes.append(type(ex).__name__)
+                except Exception as ex:  # untyped = availability failure
+                    outcomes.append(f"UNTYPED:{type(ex).__name__}")
+        threads = [_th.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, outcomes, latencies
+
+    def make_server(n):
+        return QueryServer(session, graph=graph, config=ServerConfig(
+            devices=n, max_queue=4096, max_batch=8,
+            device_failure_threshold=2, device_cooldown_s=30.0,
+            retry=RetryPolicy(max_attempts=5, backoff_base_s=0.002,
+                              backoff_max_s=0.05)))
+
+    # -- phase A: QPS scaling with device count ------------------------
+    qps_by_devices = {}
+    server = None
+    for n in sorted({1, max(1, devices_n)}):
+        if server is not None:
+            server.shutdown()
+        server = make_server(n)
+        closed_loop(server)  # warm every replica's plan cache/compiles
+        elapsed, outcomes, lats = closed_loop(server)
+        ok = sum(1 for o in outcomes if o == "ok")
+        qps_by_devices[n] = round(ok / elapsed, 1) if elapsed else 0.0
+        _result.update({
+            "metric": f"serve QPS scaling, closed-loop {clients} clients "
+                      f"x {per_client} reqs, devices 1->{devices_n} "
+                      f"({n_people} nodes, {n_edges} edges, "
+                      f"{'tpu' if on_tpu else 'cpu-simulated-devices'})",
+            "value": qps_by_devices[max(qps_by_devices)],
+            "qps_by_devices": qps_by_devices,
+            "qps_scaling": round(
+                qps_by_devices[max(qps_by_devices)]
+                / qps_by_devices[1], 3) if qps_by_devices.get(1) else 0.0,
+            **{f"devices_{n}_{k}": v
+               for k, v in _percentiles(lats).items()},
+        })
+
+    # -- phase B: availability with one of N devices killed mid-run ----
+    victim = 1 if devices_n > 1 else 0
+    if devices_n > 1 and _remaining() > 15:
+        # kill the victim's WHOLE operator stream: the count families
+        # execute the SpMV pushdown (CountPatternOp) on this backend,
+        # everything else scans — hook both
+        with device_loss(victim, op_name="CountPattern") as b1, \
+                device_loss(victim, op_name="Scan") as b2:
+            elapsed, outcomes, _lats = closed_loop(server)
+            health = dict(server.device_health())
+        budget_injected = b1.injected + b2.injected
+        ok = sum(1 for o in outcomes if o == "ok")
+        untyped = sum(1 for o in outcomes if o.startswith("UNTYPED"))
+        devs = server.stats()["devices"]
+        _result.update({
+            "value": round(ok / total, 4) if total else 0.0,
+            "unit": "fraction",
+            "metric": _result["metric"].replace(
+                "serve QPS scaling",
+                "serve availability with 1 device killed mid-run; "
+                "QPS scaling"),
+            "device_loss_injected": budget_injected,
+            "device_loss_ok": ok,
+            "device_loss_untyped_errors": untyped,
+            "device_loss_qps": round(ok / elapsed, 1) if elapsed else 0.0,
+            "victim_health_during_fault": health.get(victim),
+            "victim_quarantines": devs[victim]["quarantines"],
+            "per_device_requests": {d["device"]: d["requests"]
+                                    for d in devs},
+            "server_health_during_fault": "degraded"
+            if health.get(victim) != "healthy" else "healthy",
+        })
+    if server is not None:
+        server.shutdown()
+    _emit()
+
+
 def run_faults_config(on_tpu: bool):
     """Benchmark config 6: the serving tier under injected faults
     (ISSUE 5 — failure containment).
@@ -720,6 +874,10 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "ldbc":
         return run_ldbc_config(on_tpu)
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        if "--devices" in sys.argv:
+            i = sys.argv.index("--devices")
+            devices_n = int(sys.argv[i + 1]) if i + 1 < len(sys.argv) else 2
+            return run_serve_devices_config(on_tpu, devices_n)
         return run_serve_config(on_tpu)
     if len(sys.argv) > 1 and sys.argv[1] == "faults":
         return run_faults_config(on_tpu)

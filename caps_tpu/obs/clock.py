@@ -22,3 +22,17 @@ wall = _time.time
 #: ``sleep`` advances ``now`` instantly — retry/backoff timing becomes
 #: exactly assertable with zero real waiting (tests/test_faults.py).
 sleep = _time.sleep
+
+
+def _event_wait(event, timeout):
+    return event.wait(timeout)
+
+
+#: The single sanctioned *interruptible* wait: block up to ``timeout``
+#: seconds on a ``threading.Event``, returning True the moment it fires.
+#: Retry backoff sleeps route through here with the request's cancel
+#: event, so ``cancel()`` / non-drain shutdown wake a backing-off worker
+#: immediately instead of burning the rest of the backoff.  Fake clocks
+#: stub this alongside ``now``/``sleep`` (advance time, honor a
+#: pre-fired event instantly).
+wait = _event_wait

@@ -341,6 +341,15 @@ class RelationalCypherSession(CypherSession):
                parameters: Optional[Mapping[str, Any]] = None) -> CypherResult:
         return self.cypher_on_graph(self._ambient, query, parameters)
 
+    def clone(self) -> "RelationalCypherSession":
+        """A fresh session of the same class and config — the serving
+        tier's per-device replica seam (serve/devices.py): the clone
+        owns its own plan cache, catalog, metrics registry, and (on
+        device backends) string pool and fused memos.  Nothing compiled
+        or cached is shared with this session, so one replica's
+        corruption or quarantine can never leak into another's."""
+        return type(self)(config=self.config)
+
     def prepare(self, query: str,
                 graph: Optional[RelationalCypherGraph] = None) -> PreparedQuery:
         """Prepare a query for repeated execution: parses (and validates)

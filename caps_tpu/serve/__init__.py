@@ -17,8 +17,13 @@ The layer between many client threads and one engine session
                         deterministic jitter
     serve/breaker.py    per-plan-family circuit breakers (quarantine +
                         degraded-ladder gating, health summary)
-    serve/server.py     QueryServer: worker pool, one serialized device
-                        stream, serve.* metrics, containment ladder
+    serve/devices.py    device fault domains: per-device replica
+                        sessions + replicated graphs, the health ladder
+                        (healthy -> quarantined -> probing), background
+                        canary probes, graph replication
+    serve/server.py     QueryServer: worker pool (one worker per device
+                        replica, or one serialized stream), serve.*
+                        metrics, containment ladder, device failover
 
 Engine hooks this package owns: ``RelationalCypherSession.cypher_batch``
 (one batched pass over a cached plan), the deadline checkpoints in
@@ -34,7 +39,9 @@ from caps_tpu.serve.deadline import (CancelScope, cancel_scope, checkpoint,
 from caps_tpu.serve.errors import (Cancelled, CancellationError, CircuitOpen,
                                    DeadlineExceeded, Overloaded, QueryFailed,
                                    ServeError, ServerClosed, WaitTimeout)
-from caps_tpu.serve.failure import FATAL, POISONED_PLAN, TRANSIENT, classify
+from caps_tpu.serve.failure import (FATAL, POISONED_PLAN, TRANSIENT,
+                                    attribute_device, classify, device_fault,
+                                    device_of)
 
 _LAZY = {
     "QueryServer": "caps_tpu.serve.server",
@@ -48,6 +55,10 @@ _LAZY = {
     "BATCH": "caps_tpu.serve.request",
     "RetryPolicy": "caps_tpu.serve.retry",
     "CircuitBreaker": "caps_tpu.serve.breaker",
+    "ReplicaSet": "caps_tpu.serve.devices",
+    "DeviceReplica": "caps_tpu.serve.devices",
+    "replicate_graph": "caps_tpu.serve.devices",
+    "executing_device_index": "caps_tpu.serve.devices",
 }
 
 __all__ = [
@@ -55,6 +66,7 @@ __all__ = [
     "DeadlineExceeded", "Cancelled", "CircuitOpen", "QueryFailed",
     "WaitTimeout", "CancelScope", "cancel_scope", "checkpoint",
     "current_scope", "classify", "TRANSIENT", "POISONED_PLAN", "FATAL",
+    "device_fault", "attribute_device", "device_of",
     *sorted(_LAZY),
 ]
 

@@ -78,6 +78,7 @@ class AdmissionController:
         self.ema_service_s = 0.0
         self._admitted = registry.counter("serve.admitted")
         self._shed = registry.counter("serve.shed")
+        self._requeued = registry.counter("serve.requeued")
         self._registry = registry
         _register_depth_gauge(registry, self)
 
@@ -102,6 +103,32 @@ class AdmissionController:
             ema = self.ema_service_s
             self.ema_service_s = per_request_s if ema == 0.0 \
                 else 0.8 * ema + 0.2 * per_request_s
+
+    def set_active_workers(self, n: int) -> None:
+        """Degraded-capacity accounting (device fault domains): the
+        ``retry_after_s`` estimator divides queue depth by the number of
+        PARALLEL streams actually draining it, so a quarantined device
+        must fall out of the denominator — with W-1 of W devices live,
+        clients are told to back off proportionally longer.  The server
+        calls this on every quarantine/reinstate transition."""
+        with self._cond:
+            self.workers = max(1, int(n))
+
+    def requeue(self, request: Request) -> None:
+        """Return a CLAIMED request to the front of its priority class —
+        the device-quarantine drain path: a worker whose device was just
+        quarantined hands its unexecuted batch back to the dispatcher so
+        another device's worker serves it.  Never sheds (the request was
+        already admitted once) and works after ``close()`` (a graceful
+        drain must still complete requeued work)."""
+        with self._cond:
+            q = self._queues.get(request.priority)
+            if q is None:
+                q = self._queues[request.priority] = deque()
+            q.appendleft(request)
+            self._depth += 1
+            self._requeued.inc()
+            self._cond.notify_all()
 
     def offer(self, request: Request) -> None:
         """Admit or shed.  Raises ServerClosed / Overloaded."""

@@ -55,16 +55,23 @@ class _Family:
 
 
 class CircuitBreaker:
+    """``metric_prefix`` scopes the counters: the plan-family breaker
+    reports under ``serve.breaker.*`` (the default), while the device
+    health ladder (serve/devices.py) reuses this exact state machine
+    device-scoped under ``serve.device_breaker.*`` — quarantined is
+    open, probing is half-open, one background canary per trial slot."""
+
     def __init__(self, registry, failure_threshold: int = 3,
-                 cooldown_s: float = 5.0):
+                 cooldown_s: float = 5.0,
+                 metric_prefix: str = "serve.breaker"):
         self.failure_threshold = max(1, int(failure_threshold))
         self.cooldown_s = float(cooldown_s)
         self._lock = threading.Lock()
         self._families: Dict[Any, _Family] = {}
-        self._opened = registry.counter("serve.breaker.opened")
-        self._closed_again = registry.counter("serve.breaker.closed")
-        self._fast_fails = registry.counter("serve.breaker.fast_fail")
-        registry.gauge("serve.breaker.open", fn=self.open_count)
+        self._opened = registry.counter(f"{metric_prefix}.opened")
+        self._closed_again = registry.counter(f"{metric_prefix}.closed")
+        self._fast_fails = registry.counter(f"{metric_prefix}.fast_fail")
+        registry.gauge(f"{metric_prefix}.open", fn=self.open_count)
 
     # -- serving-path API ----------------------------------------------
 

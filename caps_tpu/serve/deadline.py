@@ -53,6 +53,13 @@ class CancelScope:
     def cancelled(self) -> bool:
         return self._cancelled.is_set()
 
+    @property
+    def cancel_event(self) -> threading.Event:
+        """The underlying cancel Event — the interruptible-wait handle
+        retry backoff sleeps block on (``clock.wait``), so ``cancel()``
+        wakes a backing-off worker immediately."""
+        return self._cancelled
+
     def elapsed(self) -> float:
         return clock.now() - self.t0
 

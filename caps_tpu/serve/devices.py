@@ -1,0 +1,438 @@
+"""Device fault domains: the replica set behind :class:`QueryServer`.
+
+ROADMAP item 1 (distributed serving): the serving tier used to drive ONE
+device stream behind one lock; `parallel/` already proves 8-device
+execution, and PR 4's failure taxonomy is the substrate for treating a
+dead device as a quarantined worker, not a dead server.  This module is
+that substrate made concrete:
+
+* :class:`DeviceReplica` — one device's worth of serving state: its own
+  engine session (per-device plan cache, string pool, fused size memos —
+  compiled/cached state NEVER crosses devices), its own replicated copy
+  of each served graph (ingest once per device), its own execution lock
+  (one dispatch stream per device), and per-device request counters.
+* :class:`ReplicaSet` — placement and the per-device health ladder
+  ``healthy -> quarantined -> probing -> healthy``, driven by the same
+  three-state breaker machine the plan families use
+  (:class:`~caps_tpu.serve.breaker.CircuitBreaker` with a
+  ``serve.device_breaker`` metric prefix): ``device_failure_threshold``
+  consecutive device-attributed failures quarantine the device; after
+  ``device_cooldown_s`` a BACKGROUND canary probe (never a user request)
+  runs half-open; its success reinstates the device, its failure buys
+  another cooldown.
+* :func:`replicate_graph` — backend-generic re-ingest of a ScanGraph
+  into another session: columns are read back to host values and rebuilt
+  through the target session's table factory, so each replica owns
+  device-resident buffers placed by ITS backend.
+* :func:`executing_device_index` — a thread-local stamp of which replica
+  the calling thread is executing on.  The fault-injection harness
+  (``testing/faults.py`` ``device_loss`` / ``sick_device``) scopes
+  injected device faults to one replica's operator stream through it.
+
+On CPU the replicas are *simulated* devices (``device=None``): distinct
+sessions with distinct cached state, which is everything the failover
+logic observes — the whole quarantine/probe/reinstate path is
+tier-1-testable with no accelerator.  On a TPU platform each replica is
+pinned to a real ``jax.devices()`` entry and all its placements and
+computations run under ``jax.default_device``.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from caps_tpu.obs import clock
+from caps_tpu.serve.breaker import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker)
+from caps_tpu.serve.deadline import cancel_scope
+from caps_tpu.serve.errors import ReplicationUnsupported
+
+#: per-device health ladder states (the rollup QueryServer.stats() shows)
+HEALTHY = "healthy"
+QUARANTINED = "quarantined"
+PROBING = "probing"
+
+_BREAKER_TO_HEALTH = {CLOSED: HEALTHY, OPEN: QUARANTINED,
+                      HALF_OPEN: PROBING}
+
+#: background-probe canary: must run a real operator stream on the
+#: replica (a plain node scan — no count pushdown, no aggregation), so a
+#: device fault scoped to this replica fails the probe too
+_CANARY_QUERY = "MATCH (n) RETURN n LIMIT 1"
+
+#: replicated graphs kept per device (LRU): each entry is a full
+#: re-ingested copy holding device buffers, so the cache must not grow
+#: with every short-lived graph a long-lived server ever saw
+MAX_REPLICA_GRAPHS = 8
+
+_exec_tls = threading.local()
+
+_session_locks_guard = threading.Lock()
+
+
+def executing_device_index() -> Optional[int]:
+    """The replica index the calling thread is currently executing on
+    (None outside a replica's execution bracket).  The device-scoped
+    fault injectors key off this."""
+    return getattr(_exec_tls, "device_index", None)
+
+
+def _session_exec_lock(session) -> threading.Lock:
+    """The ONE execution lock of a session, attached on first use: every
+    server/replica over the same session must serialize through the same
+    lock (the engine's execution state — fused record/replay activation,
+    profiling flags — is per-session)."""
+    lock = getattr(session, "_serve_exec_lock", None)
+    if lock is None:
+        with _session_locks_guard:
+            lock = getattr(session, "_serve_exec_lock", None)
+            if lock is None:
+                lock = threading.Lock()
+                session._serve_exec_lock = lock
+    return lock
+
+
+# -- graph replication -------------------------------------------------------
+
+def _clone_table(factory, table):
+    data = {c: table.column_values(c) for c in table.columns}
+    types = {c: table.column_type(c) for c in table.columns}
+    return factory.from_columns(data, types)
+
+
+def supports_replication(graph) -> bool:
+    """True when :func:`replicate_graph` can re-ingest this graph (scan
+    graphs and the empty ambient graph).  Requests against anything else
+    (union/catalog graphs) are pinned to device 0, which serves them on
+    the original session."""
+    from caps_tpu.relational.graphs import EmptyGraph, ScanGraph
+    return graph is None or isinstance(graph, (EmptyGraph, ScanGraph))
+
+
+def replicate_graph(graph, session):
+    """Re-ingest ``graph`` into ``session``: read every entity table's
+    columns back to host values and rebuild them through the target
+    session's table factory — the replica ends up with ITS OWN
+    device-resident buffers, string-pool codes, and CSR layout, sharing
+    nothing compiled or placed with the source."""
+    from caps_tpu.relational.entity_tables import (NodeTable,
+                                                   RelationshipTable)
+    from caps_tpu.relational.graphs import EmptyGraph, ScanGraph
+    if graph is None or isinstance(graph, EmptyGraph):
+        return session._ambient
+    if not isinstance(graph, ScanGraph):
+        raise ReplicationUnsupported(
+            f"cannot replicate a {type(graph).__name__} onto another "
+            f"device (only scan graphs re-ingest); requests against it "
+            f"serve on device 0")
+    factory = session.table_factory
+    node_tables = [NodeTable(nt.mapping, _clone_table(factory, nt.table))
+                   for nt in graph.node_tables]
+    rel_tables = [RelationshipTable(rt.mapping,
+                                    _clone_table(factory, rt.table))
+                  for rt in graph.rel_tables]
+    return session.create_graph(node_tables, rel_tables)
+
+
+def _acquire_devices(n: int) -> List[Any]:
+    """Real accelerator devices when the platform has them, else
+    simulated devices (None): per-session isolation is the part of the
+    fault domain the failover logic observes, and it needs no
+    accelerator."""
+    try:
+        import jax
+        devs = jax.devices()
+        if devs and devs[0].platform != "cpu" and len(devs) >= n:
+            return list(devs[:n])
+    except Exception:  # pragma: no cover — jax-less / broken platform
+        pass
+    return [None] * n
+
+
+class DeviceReplica:
+    """One device's serving state: session, graphs, lock, counters."""
+
+    def __init__(self, index: int, session, device: Any = None):
+        self.index = index
+        self.session = session
+        #: a real jax Device (TPU platform) or None (simulated device)
+        self.device = device
+        #: one dispatch stream per device: every execution on this
+        #: replica (including cross-device retries and probes) holds it
+        self.lock = _session_exec_lock(session)
+        self._stats_lock = threading.Lock()
+        self.requests = 0
+        self.completed = 0
+        self.failed = 0
+        self.quarantines = 0
+        self.reinstates = 0
+        self.probes = 0
+        #: id(template graph) -> (template graph, replica graph); LRU
+        #: bounded — insertion-ordered dict, oldest evicted past the cap
+        #: so a long-lived server cycling through many short-lived
+        #: graphs cannot pin dead graphs' device buffers forever
+        self._graphs: Dict[int, Tuple[Any, Any]] = {}
+        self._graphs_lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Execution bracket: stamps the executing-device thread-local
+        (the device-scoped fault injectors key off it) and, on
+        real-device replicas, pins jax's default placement so every
+        array this execution creates lands on THIS device."""
+        prev = getattr(_exec_tls, "device_index", None)
+        _exec_tls.device_index = self.index
+        try:
+            if self.device is None:
+                yield
+            else:
+                import jax
+                with jax.default_device(self.device):
+                    yield
+        finally:
+            _exec_tls.device_index = prev
+
+    def graph_for(self, graph):
+        """This replica's copy of ``graph``, re-ingested on first use
+        (and eagerly at server construction for the default graph).
+        Replica 0 serves the ORIGINAL objects — it owns the template
+        session, so its 'copy' is the graph itself."""
+        if self.index == 0 or graph is None:
+            return graph if graph is not None else self.session._ambient
+        key = id(graph)
+        with self._graphs_lock:
+            got = self._graphs.get(key)
+            if got is not None and got[0] is graph:
+                # LRU touch: re-insert at the newest position
+                self._graphs[key] = self._graphs.pop(key)
+                return got[1]
+            with self.activate():
+                replica_graph = replicate_graph(graph, self.session)
+            self._graphs[key] = (graph, replica_graph)
+            while len(self._graphs) > MAX_REPLICA_GRAPHS:
+                self._graphs.pop(next(iter(self._graphs)))
+            return replica_graph
+
+    def first_graph(self):
+        """A replicated scan graph to canary-probe with (None when this
+        replica has never served one)."""
+        if self.index == 0:
+            return None
+        with self._graphs_lock:
+            for _tmpl, g in self._graphs.values():
+                if getattr(g, "node_tables", None):
+                    return g
+        return None
+
+    def note(self, *, requests: int = 0, completed: int = 0,
+             failed: int = 0) -> None:
+        with self._stats_lock:
+            self.requests += requests
+            self.completed += completed
+            self.failed += failed
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            return {"device": self.index,
+                    "placement": "simulated" if self.device is None
+                    else str(self.device),
+                    "requests": self.requests,
+                    "completed": self.completed,
+                    "failed": self.failed,
+                    "quarantines": self.quarantines,
+                    "reinstates": self.reinstates,
+                    "probes": self.probes}
+
+
+class ReplicaSet:
+    """N device replicas + the per-device health ladder.
+
+    ``session`` is the template: replica 0 reuses it (and the caller's
+    original graph objects); replicas 1..N-1 get fresh
+    ``session.clone()`` sessions with their own plan caches, string
+    pools, and fused memos, plus re-ingested graph copies — compiled
+    state never migrates across devices (docs/tpu.md).
+
+    The health ladder reuses the breaker state machine, device-scoped:
+    quarantined == open (the device serves nothing), probing ==
+    half-open (exactly one background canary in flight).  Only
+    *device-attributed* failures (``serve.failure.device_fault``) climb
+    the ladder — a user's bad query must never take a device down.  With
+    a single replica the ladder is disabled: there is no second device
+    to fail over to, so quarantining the only one would turn a sick
+    device into a dead server.
+    """
+
+    def __init__(self, session, graph=None, n_devices: int = 1,
+                 registry=None, failure_threshold: int = 3,
+                 cooldown_s: float = 1.0, on_change=None):
+        n = max(1, int(n_devices))
+        devices = _acquire_devices(n)
+        self.replicas: List[DeviceReplica] = []
+        for i in range(n):
+            s = session if i == 0 else session.clone()
+            self.replicas.append(DeviceReplica(i, s, devices[i]))
+        if graph is not None and supports_replication(graph):
+            # ingest once per device, up front: serving never pays a
+            # surprise re-ingest, and a broken replication fails loudly
+            # at construction.  Non-replicable default graphs (union /
+            # catalog) are NOT an error — their requests pin to
+            # device 0 (replica_for), the other replicas idle for them.
+            for r in self.replicas:
+                r.graph_for(graph)
+        self._breaker = CircuitBreaker(
+            registry, failure_threshold=failure_threshold,
+            cooldown_s=cooldown_s, metric_prefix="serve.device_breaker")
+        self._quarantined_c = registry.counter("serve.devices.quarantined")
+        self._reinstated_c = registry.counter("serve.devices.reinstated")
+        self._probes_c = registry.counter("serve.devices.probes")
+        self._on_change = on_change
+        self._rr = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    # -- health --------------------------------------------------------
+
+    def state(self, replica) -> str:
+        index = replica.index if isinstance(replica, DeviceReplica) \
+            else int(replica)
+        if len(self.replicas) == 1:
+            return HEALTHY
+        return _BREAKER_TO_HEALTH[self._breaker.state(index)]
+
+    def is_healthy(self, replica) -> bool:
+        return self.state(replica) == HEALTHY
+
+    def live_count(self) -> int:
+        return sum(1 for r in self.replicas if self.is_healthy(r))
+
+    def quarantined_count(self) -> int:
+        return len(self.replicas) - self.live_count()
+
+    def health(self) -> Dict[int, str]:
+        return {r.index: self.state(r) for r in self.replicas}
+
+    def _changed(self) -> None:
+        if self._on_change is not None:
+            try:
+                self._on_change()
+            except Exception:  # pragma: no cover — bookkeeping only
+                pass
+
+    # -- outcome bookkeeping (the ladder's input) ----------------------
+
+    def record_success(self, replica: DeviceReplica) -> None:
+        replica.note(completed=1)
+        if len(self.replicas) > 1:
+            self._breaker.record_success(replica.index)
+
+    def record_failure(self, replica: DeviceReplica,
+                       exc: BaseException) -> bool:
+        """Fold one execution failure in.  Only device-attributed errors
+        count against the device; returns True when THIS failure
+        quarantined it (the caller drains its claimed work back to the
+        dispatcher and lets the background probe reinstate it)."""
+        from caps_tpu.serve.failure import device_fault
+        replica.note(failed=1)
+        if len(self.replicas) == 1 or not device_fault(exc):
+            return False
+        tripped = self._breaker.record_failure(replica.index, exc)
+        if tripped:
+            with replica._stats_lock:
+                replica.quarantines += 1
+            self._quarantined_c.inc()
+            tracer = replica.session.tracer
+            if tracer.enabled:
+                tracer.event("device.quarantined", device=replica.index,
+                             error=type(exc).__name__)
+            self._changed()
+        return tripped
+
+    # -- background probe (quarantined -> probing -> healthy) ----------
+
+    def try_probe(self, replica: DeviceReplica):
+        """Breaker admit for the background probe: ``(TRIAL, 0)`` when
+        the cooldown elapsed and this caller owns the single probe slot,
+        else ``(REJECT, remaining_cooldown)``."""
+        return self._breaker.admit(replica.index)
+
+    def probe(self, replica: DeviceReplica) -> bool:
+        """Run the health canary on the replica's own session/device —
+        a replicated-graph scan when one exists (so operator-stream
+        faults scoped to this device fail the probe), else a tiny
+        arithmetic program.  Success reinstates the device; failure
+        re-opens the quarantine for another cooldown."""
+        replica.note()
+        with replica._stats_lock:
+            replica.probes += 1
+        self._probes_c.inc()
+        tracer = replica.session.tracer
+        try:
+            with replica.lock, cancel_scope(None), replica.activate():
+                g = replica.first_graph()
+                if g is not None:
+                    g.cypher(_CANARY_QUERY)
+                else:
+                    self._arith_canary(replica.device)
+            ok = True
+        except BaseException:
+            ok = False
+        if ok:
+            was = self._breaker.state(replica.index)
+            self._breaker.record_success(replica.index)
+            if was != CLOSED:
+                with replica._stats_lock:
+                    replica.reinstates += 1
+                self._reinstated_c.inc()
+                if tracer.enabled:
+                    tracer.event("device.reinstated", device=replica.index)
+        else:
+            self._breaker.record_failure(replica.index)
+            if tracer.enabled:
+                tracer.event("device.probe_failed", device=replica.index)
+        self._changed()
+        return ok
+
+    @staticmethod
+    def _arith_canary(device) -> None:
+        import jax
+        import jax.numpy as jnp
+        x = jnp.arange(8, dtype=jnp.int32)
+        if device is not None:
+            x = jax.device_put(x, device)
+        got = int((x * 2 + 1).sum())
+        if got != 64:  # pragma: no cover — silent corruption
+            raise ReplicationUnsupported(
+                f"device canary arithmetic returned {got}, expected 64")
+
+    # -- placement -----------------------------------------------------
+
+    def replica_for(self, replica: DeviceReplica, graph) -> DeviceReplica:
+        """Where a claimed batch actually executes: the claiming worker's
+        own device, except non-replicable graphs (union/catalog graphs)
+        which pin to device 0 — the template session is the only one
+        that can resolve them."""
+        if replica.index != 0 and not supports_replication(graph):
+            return self.replicas[0]
+        return replica
+
+    def retry_target(self, exclude_index: int) -> DeviceReplica:
+        """A DIFFERENT healthy device for a transient retry (round-robin
+        over the healthy survivors).  Falls back to the excluded device
+        itself when it is the only one — a single-device retry is still
+        better than giving up."""
+        cands = [r for r in self.replicas
+                 if r.index != exclude_index and self.is_healthy(r)]
+        if not cands:
+            return self.replicas[exclude_index]
+        return cands[next(self._rr) % len(cands)]
+
+    def summary(self) -> List[Dict[str, Any]]:
+        out = []
+        for r in self.replicas:
+            snap = r.snapshot()
+            snap["health"] = self.state(r)
+            out.append(snap)
+        return out

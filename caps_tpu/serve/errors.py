@@ -77,6 +77,13 @@ class CircuitOpen(QueryFailed):
         super().__init__(message, attempts=(), retry_after_s=retry_after_s)
 
 
+class ReplicationUnsupported(ServeError):
+    """A graph that cannot be re-ingested onto another device replica
+    (only scan graphs and the empty ambient graph replicate — see
+    ``serve/devices.py``).  The server never surfaces this to clients:
+    requests against such graphs are pinned to device 0."""
+
+
 class CancellationError(ServeError):
     """Base of the two cooperative-cancel outcomes (deadline, explicit).
 

@@ -73,6 +73,38 @@ def is_device_error(exc: BaseException) -> bool:
                for c in type(exc).__mro__)
 
 
+def device_fault(exc: BaseException) -> bool:
+    """True when the error indicts the DEVICE rather than the query or
+    the cached plan — the only failures the per-device health ladder
+    (serve/devices.py) counts.  An explicit ``caps_device_fault`` marker
+    wins (the device-scoped fault injectors stamp it); otherwise
+    device-runtime errors by MRO name and connection failures (a dead
+    device tunnel) qualify.  A user's bad query must never take a
+    device down."""
+    marker = getattr(exc, "caps_device_fault", None)
+    if marker is not None:
+        return bool(marker)
+    return is_device_error(exc) or isinstance(exc, ConnectionError)
+
+
+def attribute_device(exc: BaseException, device_index: int) -> None:
+    """Stamp the replica index an execution error was observed on —
+    first-writer-wins, like ``caps_failed_op`` (relational/ops.py): the
+    device CLOSEST to the failure keeps the attribution through retries
+    on other devices."""
+    try:
+        if getattr(exc, "caps_device_index", None) is None:
+            exc.caps_device_index = device_index
+    except Exception:  # pragma: no cover — immutable exception types
+        pass
+
+
+def device_of(exc: BaseException):
+    """The replica index stamped by :func:`attribute_device` (None when
+    the error never crossed a device execution bracket)."""
+    return getattr(exc, "caps_device_index", None)
+
+
 def classify(exc: BaseException) -> str:
     """Map one raised exception to its containment treatment."""
     # explicit marker wins: the fault harness and backend code stamp

@@ -69,7 +69,17 @@ class RetryPolicy:
             return True
         return remaining_s > backoff_s
 
-    def sleep(self, backoff_s: float) -> None:
-        """The one sanctioned wait (stubbed by fake clocks in tests)."""
-        if backoff_s > 0:
+    def sleep(self, backoff_s: float, scope=None) -> None:
+        """The one sanctioned backoff wait (stubbed by fake clocks in
+        tests).  With a :class:`~caps_tpu.serve.deadline.CancelScope`
+        the sleep is INTERRUPTIBLE: it blocks on the scope's cancel
+        event via ``clock.wait``, so ``cancel()`` (or a non-drain
+        shutdown cancelling in-flight requests) wakes the worker
+        immediately instead of burning the rest of the backoff — the
+        caller re-checks ``scope.cancelled`` on return."""
+        if backoff_s <= 0:
+            return
+        if scope is None:
             clock.sleep(backoff_s)
+            return
+        clock.wait(scope.cancel_event, backoff_s)

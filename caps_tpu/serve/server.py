@@ -1,8 +1,8 @@
 """QueryServer: the multi-client query-serving tier.
 
-Turns a single engine session into a service: clients ``submit()``
-queries from any thread and get Future-style handles back; a worker
-pool executes them through the session's prepared-plan path with
+Turns an engine session into a service: clients ``submit()`` queries
+from any thread and get Future-style handles back; a worker pool
+executes them through the session's prepared-plan path with
 
 * **admission control** — a bounded priority queue that sheds load with
   a typed ``Overloaded`` (retry_after hint) instead of queuing
@@ -12,21 +12,28 @@ pool executes them through the session's prepared-plan path with
   cached plan (serve/batcher.py, ``session.cypher_batch``);
 * **deadlines + cooperative cancellation** — per-request budgets
   checked at engine phase boundaries (serve/deadline.py), with the
-  expiry phase attributed in the error and the trace.
+  expiry phase attributed in the error and the trace;
+* **device fault domains** — with ``ServerConfig.devices=N`` the pool
+  runs one worker per device replica (serve/devices.py): each worker
+  owns a device with its own session (per-device plan cache, string
+  pool, fused memos) and a replicated copy of the served graph, so N
+  dispatch streams run in parallel.  Transient failures retry on a
+  DIFFERENT device; ``device_failure_threshold`` consecutive
+  device-attributed failures quarantine the device (its claimed work
+  drains back to the dispatcher, capacity degrades to N-1, and the
+  admission controller's retry_after estimator is told so), and a
+  background canary probe reinstates it after ``device_cooldown_s``.
 
-Execution is serialized through one lock by default: the engine drives
-ONE device, and on TPU throughput comes from keeping that device's
-dispatch stream dense (fused replay + batching), not from concurrent
-host threads racing into it.  Workers still overlap usefully — while
-one executes, others admit, time out, and materialize results.  The
-engine-side structures a serving session shares across threads (plan
-cache, catalog, metrics registry) are individually locked, so the
-submit path never contends with execution.
+With ``devices=None`` (the default) execution is serialized through one
+device stream exactly as before: workers share replica 0 — the caller's
+own session — and overlap admission, timeout handling, and
+materialization while one executes.
 
 Serving metrics land in the session's registry under ``serve.*``
-(queue depth gauge, admitted/shed/completed counters, latency +
-queue-wait + batch-size histograms) and show up in
-``session.metrics_snapshot()`` next to everything else.
+(queue depth gauge, admitted/shed/completed/requeued counters, latency +
+queue-wait + batch-size histograms, device quarantine/reinstate
+transitions) and show up in ``session.metrics_snapshot()`` next to
+everything else.
 """
 from __future__ import annotations
 
@@ -41,10 +48,12 @@ from caps_tpu.serve.admission import AdmissionController
 from caps_tpu.serve.batcher import MicroBatcher
 from caps_tpu.serve.breaker import REJECT, TRIAL, CircuitBreaker
 from caps_tpu.serve.deadline import CancelScope, cancel_scope
+from caps_tpu.serve.devices import DeviceReplica, ReplicaSet
 from caps_tpu.serve.errors import (Cancelled, CancellationError, CircuitOpen,
                                    DeadlineExceeded, QueryFailed,
                                    ServerClosed)
-from caps_tpu.serve.failure import FATAL, TRANSIENT, classify
+from caps_tpu.serve.failure import (FATAL, TRANSIENT, attribute_device,
+                                    classify, device_of)
 from caps_tpu.serve.request import INTERACTIVE, QueryHandle, Request
 from caps_tpu.serve.retry import RetryPolicy
 
@@ -59,29 +68,47 @@ _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 #: AND per-operator unfused execution (no shared cached state at all).
 _LADDER = ("fused", "replan", "unfused")
 
-_session_locks_guard = threading.Lock()
+#: upper bound on a quarantined worker's nap between probe checks —
+#: keeps it responsive to shutdown without hot-spinning
+_PROBE_NAP_S = 0.05
 
 
-def _session_exec_lock(session) -> threading.Lock:
-    """The ONE execution lock of a session, attached on first use: every
-    QueryServer over the same session must serialize through the same
-    lock (the engine's execution state — fused record/replay activation,
-    profiling flags — is per-session, not per-server)."""
-    lock = getattr(session, "_serve_exec_lock", None)
-    if lock is None:
-        with _session_locks_guard:
-            lock = getattr(session, "_serve_exec_lock", None)
-            if lock is None:
-                lock = threading.Lock()
-                session._serve_exec_lock = lock
-    return lock
+def _fresh_copy(ex: BaseException) -> BaseException:
+    """A fresh same-type exception for fanning one batch-level setup
+    failure out to every member (handles must never share one mutable
+    error object).  The classification markers ride along — a copy that
+    lost ``caps_transient`` would send its member down the quarantine
+    ladder while the original retried.  Exception types with
+    non-reconstructible constructors fall back to the original
+    instance."""
+    try:
+        fresh = type(ex)(*ex.args)
+    except Exception:
+        return ex
+    for attr in ("caps_transient", "caps_device_fault", "caps_failed_op",
+                 "caps_device_index"):
+        val = getattr(ex, attr, None)
+        if val is not None:
+            try:
+                setattr(fresh, attr, val)
+            except Exception:  # pragma: no cover — slotted exception
+                return ex
+    return fresh
 
 
 @dataclasses.dataclass(frozen=True)
 class ServerConfig:
-    #: worker threads; execution itself is serialized (one device
-    #: stream), extra workers overlap admission and materialization
+    #: worker threads when ``devices`` is None: execution then runs one
+    #: serialized device stream, extra workers overlap admission and
+    #: materialization.  With ``devices=N`` the pool is one worker per
+    #: device and this field is ignored.
     workers: int = 2
+    #: device replicas (serve/devices.py): N parallel dispatch streams,
+    #: each worker owning a device with a replicated graph and its own
+    #: compiled state.  None = single-stream legacy mode on the caller's
+    #: session.  On CPU the replicas are simulated devices; on a TPU
+    #: platform they pin to real ``jax.devices()``.
+    devices: Optional[int] = None
     #: global queue bound — beyond it submit() sheds with Overloaded
     max_queue: int = 64
     #: optional per-priority queue caps, e.g. {BATCH: 16} keeps
@@ -98,7 +125,9 @@ class ServerConfig:
     #: materialize rows on the worker (handle.rows() is then free)
     materialize: bool = True
     #: transient-error retry (serve/retry.py): exponential backoff with
-    #: deterministic jitter, charged against the request's deadline
+    #: deterministic jitter, charged against the request's deadline;
+    #: with multiple devices the re-execution fails over to a DIFFERENT
+    #: healthy device
     retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
     #: consecutive request-level failures (whole containment ladder
     #: exhausted) before a plan family's circuit breaker opens
@@ -106,6 +135,14 @@ class ServerConfig:
     #: seconds an open breaker fast-fails a family before letting one
     #: half-open trial through
     breaker_cooldown_s: float = 5.0
+    #: consecutive DEVICE-attributed failures (serve/failure.py
+    #: ``device_fault``) before a device replica is quarantined; only
+    #: meaningful with ``devices >= 2`` (there is no failover target
+    #: for a single device)
+    device_failure_threshold: int = 3
+    #: seconds a quarantined device sits out before each background
+    #: half-open canary probe
+    device_cooldown_s: float = 1.0
 
 
 class QueryServer:
@@ -129,7 +166,7 @@ class QueryServer:
         self.admission = AdmissionController(
             registry, max_queue=self.config.max_queue,
             per_priority_limits=self.config.per_priority_limits,
-            workers=self.config.workers)
+            workers=self.config.devices or self.config.workers)
         self.batcher = MicroBatcher(self.admission,
                                     max_batch=self.config.max_batch,
                                     window_s=self.config.batch_window_s)
@@ -137,10 +174,17 @@ class QueryServer:
         self.breaker = CircuitBreaker(
             registry, failure_threshold=self.config.breaker_threshold,
             cooldown_s=self.config.breaker_cooldown_s)
-        # ONE device stream: execution is serialized; workers overlap
-        # on admission, timeout handling, and materialization.  The
-        # lock is per-SESSION (shared by every server over it).
-        self._exec_lock = _session_exec_lock(session)
+        #: the device fault domains: replica 0 is the caller's session;
+        #: replicas 1..N-1 are clones with re-ingested graph copies.
+        #: Quarantine/reinstate transitions re-tell the admission
+        #: controller how many parallel streams are actually live.
+        self.devices = ReplicaSet(
+            session, graph=graph, n_devices=self.config.devices or 1,
+            registry=registry,
+            failure_threshold=self.config.device_failure_threshold,
+            cooldown_s=self.config.device_cooldown_s,
+            on_change=lambda: self.admission.set_active_workers(
+                self.devices.live_count() or 1))
         self._completed = registry.counter("serve.completed")
         self._failed = registry.counter("serve.failed")
         self._cancelled = registry.counter("serve.cancelled")
@@ -156,6 +200,11 @@ class QueryServer:
         self._registry = registry
         self._threads: List[threading.Thread] = []
         self._started = False
+        #: requests currently claimed by workers — a non-drain shutdown
+        #: cancels their scopes so backoff sleeps and engine checkpoints
+        #: end them promptly
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
         if start:
             self.start()
 
@@ -164,13 +213,21 @@ class QueryServer:
     def start(self) -> "QueryServer":
         """Start the worker pool (idempotent).  ``start=False`` at
         construction lets tests and benchmarks pre-load the queue so the
-        first batch demonstrably coalesces."""
+        first batch demonstrably coalesces.  With ``devices=N`` the pool
+        is one worker per device replica; otherwise ``workers`` threads
+        share replica 0 (one serialized stream)."""
         if self._started:
             return self
         self._started = True
-        for i in range(max(1, self.config.workers)):
-            t = threading.Thread(target=self._worker_loop,
-                                 name=f"caps-tpu-serve-{i}", daemon=True)
+        if self.config.devices is not None:
+            bindings = list(self.devices.replicas)
+        else:
+            bindings = [self.devices.replicas[0]] \
+                * max(1, self.config.workers)
+        for i, replica in enumerate(bindings):
+            t = threading.Thread(
+                target=self._worker_loop, args=(replica,),
+                name=f"caps-tpu-serve-{i}-dev{replica.index}", daemon=True)
             self._threads.append(t)
             t.start()
         return self
@@ -179,10 +236,11 @@ class QueryServer:
                  timeout: Optional[float] = None) -> bool:
         """Stop accepting work.  ``drain=True`` (default) completes
         everything already queued before workers exit; ``drain=False``
-        fails queued requests with ``Cancelled``.  ``timeout`` bounds
-        the TOTAL wait for workers; returns False (with the worker
-        handles retained, so a later call can finish the join) when
-        they are still running at the deadline."""
+        fails queued requests with ``Cancelled`` AND cancels in-flight
+        ones (their backoff sleeps wake immediately — serve/retry.py).
+        ``timeout`` bounds the TOTAL wait for workers; returns False
+        (with the worker handles retained, so a later call can finish
+        the join) when they are still running at the deadline."""
         self.admission.close()
         if not drain:
             for req in self.admission.drain_remaining():
@@ -190,6 +248,10 @@ class QueryServer:
                 req.handle._complete(
                     exception=Cancelled(phase="queued"))
                 self._cancelled.inc()
+            with self._inflight_lock:
+                inflight = list(self._inflight)
+            for req in inflight:
+                req.scope.cancel()
         elif not self._started and self.admission.depth() > 0:
             # never-started server with a backlog: draining means the
             # queued work still completes — spin the workers up; they
@@ -246,28 +308,43 @@ class QueryServer:
     def stats(self) -> Dict[str, Any]:
         """The ``serve.*`` slice of the metrics registry, unprefixed,
         plus the failure-containment summary (``health``, per-family
-        breaker states)."""
+        breaker states) and the per-device fault-domain view
+        (``devices``: health, request counts, quarantine/reinstate
+        transition counters per replica)."""
         snap = self._registry.snapshot()
         out = {k[len("serve."):]: v for k, v in snap.items()
                if k.startswith("serve.")}
         out["health"] = self.health()
         out["breakers"] = self.breaker.summary()
+        out["devices"] = self.devices.summary()
         return out
 
     def health(self) -> str:
-        """One-word serving health: ``healthy`` (all families closed),
-        ``degraded`` (>= 1 family's breaker open / half-open — those
-        families fast-fail or probe while everything else serves), or
-        ``lame-duck`` (shutdown began: draining, accepting nothing
-        new)."""
+        """One-word serving health: ``healthy`` (all plan families
+        closed, all devices serving), ``degraded`` (>= 1 family breaker
+        open / half-open OR >= 1 device quarantined / probing — the rest
+        keeps serving at reduced capacity), or ``lame-duck`` (shutdown
+        began: draining, accepting nothing new).  Per-device detail is
+        in :meth:`device_health` / ``stats()["devices"]``."""
         if self.admission.closed:
             return "lame-duck"
-        return "degraded" if self.breaker.open_count() else "healthy"
+        if self.breaker.open_count() or self.devices.quarantined_count():
+            return "degraded"
+        return "healthy"
+
+    def device_health(self) -> Dict[int, str]:
+        """Per-device health ladder states:
+        ``{device_index: healthy | quarantined | probing}``."""
+        return self.devices.health()
 
     # -- worker pool ---------------------------------------------------
 
-    def _worker_loop(self) -> None:
+    def _worker_loop(self, replica: DeviceReplica) -> None:
         while True:
+            if not self.devices.is_healthy(replica):
+                if not self._quarantined_idle(replica):
+                    return
+                continue
             # blocking take: idle workers sleep on the queue's condition
             # variable (close() wakes them) instead of polling
             batch = self.batcher.next_batch(timeout=None)
@@ -276,11 +353,35 @@ class QueryServer:
                     return
                 continue
             try:
-                self._execute_batch(batch)
+                self._execute_batch(batch, replica)
             except BaseException as ex:  # pragma: no cover — last resort
                 for req in batch:
                     if not req.handle.done():
                         req.handle._complete(exception=ex)
+
+    def _quarantined_idle(self, replica: DeviceReplica) -> bool:
+        """What a worker does while ITS device is quarantined: the other
+        workers keep draining the shared queue (capacity degrades to the
+        live devices); this one drives the BACKGROUND half-open probe on
+        the ladder's cooldown cadence — user requests are never spent as
+        probes.  Returns False when the worker should exit (shutdown
+        with nothing left this worker could help with)."""
+        if self.admission.closed:
+            if self.devices.live_count() == 0:
+                # nobody can serve the backlog: fail it loudly instead
+                # of hanging the drain forever
+                for req in self.admission.drain_remaining():
+                    self._finish(req, QueryFailed(
+                        "shutdown with no healthy devices left to drain "
+                        "the queue"))
+            if self.admission.depth() == 0:
+                return False
+        verdict, retry_after = self.devices.try_probe(replica)
+        if verdict == TRIAL:
+            self.devices.probe(replica)
+        else:
+            clock.sleep(min(max(retry_after, 1e-3), _PROBE_NAP_S))
+        return True
 
     def _observed(self):
         """Activate the session tracer for worker-side checks (queue
@@ -291,6 +392,18 @@ class QueryServer:
         if session_observed is not None:
             return session_observed()
         return contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def _tracked(self, reqs: List[Request]):
+        """In-flight bookkeeping: shutdown(drain=False) cancels these
+        scopes so retries and backoff sleeps end promptly."""
+        with self._inflight_lock:
+            self._inflight.update(reqs)
+        try:
+            yield
+        finally:
+            with self._inflight_lock:
+                self._inflight.difference_update(reqs)
 
     def _admit_for_execution(self, batch: List[Request]) -> List[Request]:
         """Drop members that were cancelled or expired while queued and
@@ -322,10 +435,31 @@ class QueryServer:
             return req.batch_key
         return ("solo", req.mode, req.query)
 
-    def _execute_batch(self, batch: List[Request]) -> None:
+    def _requeue(self, reqs: List[Request]) -> None:
+        """Drain claimed-but-unexecuted work back to the dispatcher —
+        the quarantine path: another device's worker serves it.  Front
+        of the queue, original order preserved."""
+        for req in reversed(reqs):
+            self.admission.requeue(req)
+
+    def _execute_batch(self, batch: List[Request],
+                       replica: DeviceReplica) -> None:
         live = self._admit_for_execution(batch)
         if not live:
             return
+        if not self.devices.is_healthy(replica):
+            # the device was quarantined between the claim and now (a
+            # cross-device retry recorded the tripping failure): hand
+            # the whole batch back to the dispatcher
+            self._requeue(live)
+            return
+        # non-replicable graphs (union/catalog) pin to device 0
+        replica = self.devices.replica_for(replica, live[0].graph)
+        with self._tracked(live):
+            self._execute_live(live, replica)
+
+    def _execute_live(self, live: List[Request],
+                      replica: DeviceReplica) -> None:
         family = self._family(live[0])
         verdict, retry_after = self.breaker.admit(family)
         if verdict == REJECT:
@@ -353,9 +487,9 @@ class QueryServer:
                 probe.handle.info["batch_size"] = 1
                 self._batches.inc()
                 self._batch_hist.observe(1)
-                outcome = self._execute_single(probe, level=1)
+                outcome = self._execute_single(probe, 1, replica)
                 if isinstance(outcome, BaseException):
-                    outcome = self._recover(probe, outcome, 1)
+                    outcome = self._recover(probe, outcome, 1, replica)
                 if isinstance(outcome, CancellationError):
                     self.breaker.abort_trial(family)
                     self._finish(probe, outcome)
@@ -381,26 +515,41 @@ class QueryServer:
         self._batch_hist.observe(n)
         for req in live:
             req.handle.info["batch_size"] = n
-        with self._exec_lock:
+            req.handle.info["device"] = replica.index
+        with replica.lock:
             # service time starts INSIDE the lock: time spent queued
-            # behind another worker's batch is queueing, not service,
-            # and must not inflate the retry_after estimator
+            # behind another batch on this device's stream is queueing,
+            # not service, and must not inflate the retry_after estimator
             t0 = clock.now()
             if n > 1:
-                outcomes = self.session.cypher_batch(
-                    live[0].graph, [(r.query, r.params) for r in live],
-                    scopes=[r.scope for r in live])
+                try:
+                    with replica.activate():
+                        graph = replica.graph_for(live[0].graph)
+                        outcomes = replica.session.cypher_batch(
+                            graph, [(r.query, r.params) for r in live],
+                            scopes=[r.scope for r in live])
+                except BaseException as ex:  # replication / setup failed
+                    outcomes = [ex] + [_fresh_copy(ex)
+                                       for _ in live[1:]]
             else:
                 req = live[0]
                 try:
-                    with cancel_scope(req.scope):
-                        outcomes = [self.session.cypher_on_graph(
-                            req.graph, req.query, req.params)]
+                    with cancel_scope(req.scope), replica.activate():
+                        graph = replica.graph_for(req.graph)
+                        outcomes = [replica.session.cypher_on_graph(
+                            graph, req.query, req.params)]
                 except BaseException as ex:
                     outcomes = [ex]
             exec_s = clock.now() - t0
         # feed the admission controller's retry_after estimator
         self.admission.observe_service(exec_s / n)
+        # per-device fault-domain bookkeeping on the RAW outcomes: the
+        # device that produced a failure owns it, whatever device the
+        # recovery below lands on
+        for outcome in outcomes:
+            if isinstance(outcome, BaseException):
+                attribute_device(outcome, replica.index)
+        self._note_device_outcomes(replica, outcomes)
         # successful members complete FIRST: a failed sibling's recovery
         # (backoff sleeps + serialized re-executions) must not sit
         # between a finished result and the client waiting on it
@@ -412,7 +561,7 @@ class QueryServer:
                 self.breaker.record_success(family)
                 self._finish(req, outcome)
         for req, exc in pending:
-            outcome = self._recover(req, exc, 0)
+            outcome = self._recover(req, exc, 0, replica)
             # breaker bookkeeping on the request's FINAL outcome;
             # cancellation/deadline expiry is the budget's verdict, not
             # the family's
@@ -424,22 +573,39 @@ class QueryServer:
                         # shared cached state so the half-open trial (and
                         # the eventual recovery) re-plans from scratch —
                         # unless the recovery ladder already did
-                        self._quarantine(req)
+                        self._quarantine(req, replica)
             else:
                 self.breaker.record_success(family)
             self._finish(req, outcome)
 
+    def _note_device_outcomes(self, replica: DeviceReplica,
+                              outcomes: List[Any]) -> None:
+        """Feed one batch of raw outcomes to the device health ladder.
+        Cancellation/deadline expiry is the budget's verdict — it says
+        nothing about the device."""
+        for outcome in outcomes:
+            replica.note(requests=1)
+            if isinstance(outcome, CancellationError):
+                continue
+            if isinstance(outcome, BaseException):
+                self.devices.record_failure(replica, outcome)
+            else:
+                self.devices.record_success(replica)
+
     # -- failure containment (retry / quarantine / degraded ladder) ----
 
-    def _recover(self, req: Request, exc: BaseException, level: int) -> Any:
+    def _recover(self, req: Request, exc: BaseException, level: int,
+                 replica: DeviceReplica) -> Any:
         """Containment ladder for ONE failed request: classify the
-        error, then either return it (fatal / cancelled), retry the same
-        path with deadline-charged backoff (transient), or quarantine
-        the cached plan and climb the degraded ladder (poisoned).
+        error, then either return it (fatal / cancelled), retry with
+        deadline-charged backoff on a DIFFERENT healthy device
+        (transient — the failed device may be the problem; a lone
+        device retries on itself), or quarantine the cached plan and
+        climb the degraded ladder on the same device (poisoned).
         Returns the final outcome — a CypherResult or the exception to
         complete the handle with.  Never raises."""
         policy = self.retry_policy
-        attempts = [self._attempt_entry(exc, level)]
+        attempts = [self._attempt_entry(exc, level, replica)]
         executions = 1
         current: BaseException = exc
         while True:
@@ -474,8 +640,21 @@ class QueryServer:
                 if tracer.enabled:
                     tracer.event("retry.attempt", attempt=executions,
                                  backoff_s=backoff, mode=_LADDER[level],
+                                 device=replica.index,
                                  error=type(current).__name__)
-                policy.sleep(backoff)
+                policy.sleep(backoff, scope=req.scope)
+                if req.scope.cancelled:
+                    # cancel() fired DURING the backoff: the wait woke
+                    # immediately (serve/retry.py) and the request ends
+                    # here — no doomed re-execution, no burned sleep
+                    current = Cancelled(phase="backoff")
+                    break
+                # device failover: re-execute on a different healthy
+                # device when one exists — routed through replica_for,
+                # so non-replicable graphs keep retrying on device 0
+                replica = self.devices.replica_for(
+                    self.devices.retry_target(
+                        exclude_index=replica.index), req.graph)
             else:  # POISONED_PLAN: quarantine once, then climb the ladder
                 if level >= len(_LADDER) - 1:
                     current = QueryFailed(
@@ -484,65 +663,79 @@ class QueryServer:
                         attempts=tuple(attempts))
                     break
                 if level == 0:
-                    self._quarantine(req)
+                    self._quarantine(req, replica)
                 level += 1
                 self._degraded_runs.inc()
             executions += 1
-            outcome = self._execute_single(req, level)
+            outcome = self._execute_single(req, level, replica)
             if not isinstance(outcome, BaseException):
-                attempts.append({"mode": _LADDER[level], "ok": True})
+                attempts.append({"mode": _LADDER[level], "ok": True,
+                                 "device": replica.index})
                 req.handle.info["attempts"] = attempts
                 return outcome
-            attempts.append(self._attempt_entry(outcome, level))
+            attempts.append(self._attempt_entry(outcome, level, replica))
             current = outcome
         req.handle.info["attempts"] = attempts
         return current
 
     @staticmethod
-    def _attempt_entry(exc: BaseException, level: int) -> Dict[str, Any]:
+    def _attempt_entry(exc: BaseException, level: int,
+                       replica: DeviceReplica) -> Dict[str, Any]:
         """One attempt-history record.  A fresh dict per attempt per
         request — failure context lives HERE, never as mutations of the
         exception object (which a badly-behaved injector might share
         across batch members)."""
+        dev = device_of(exc)
         entry = {"mode": _LADDER[level], "error": type(exc).__name__,
-                 "message": str(exc)[:200], "classified": classify(exc)}
+                 "message": str(exc)[:200], "classified": classify(exc),
+                 "device": replica.index if dev is None else dev}
         failed_op = getattr(exc, "caps_failed_op", None)
         if failed_op is not None:
             entry["op"] = failed_op
         return entry
 
-    def _execute_single(self, req: Request, level: int) -> Any:
-        """One (re-)execution of a single request at a ladder level.
-        Returns the result or the raised exception."""
-        with self._exec_lock:
+    def _execute_single(self, req: Request, level: int,
+                        replica: DeviceReplica) -> Any:
+        """One (re-)execution of a single request at a ladder level on
+        ``replica``'s device.  Returns the result or the raised
+        exception; device-ladder bookkeeping included."""
+        with replica.lock:
             t0 = clock.now()
             try:
-                with cancel_scope(req.scope):
+                with cancel_scope(req.scope), replica.activate():
+                    graph = replica.graph_for(req.graph)
                     if level == 0:
-                        return self.session.cypher_on_graph(
-                            req.graph, req.query, req.params)
-                    return self.session.cypher_degraded(
-                        req.graph, req.query, req.params,
-                        no_plan_cache=True, no_fused=(level >= 2))
+                        out: Any = replica.session.cypher_on_graph(
+                            graph, req.query, req.params)
+                    else:
+                        out = replica.session.cypher_degraded(
+                            graph, req.query, req.params,
+                            no_plan_cache=True, no_fused=(level >= 2))
             except BaseException as ex:
-                return ex
+                attribute_device(ex, replica.index)
+                out = ex
             finally:
                 self.admission.observe_service(clock.now() - t0)
+        self._note_device_outcomes(replica, [out])
+        return out
 
-    def _quarantine(self, req: Request) -> None:
-        """Evict the request family's shared cached state: the session
-        plan-cache entry (relational/plan_cache.py) and, on the TPU
-        backend, the fused size memos (backends/tpu/fused.py) — a
-        poisoned entry must not keep failing every future hit.
+    def _quarantine(self, req: Request, replica: DeviceReplica) -> None:
+        """Evict the request family's shared cached state ON THE REPLICA
+        THAT SERVED IT: that session's plan-cache entry
+        (relational/plan_cache.py) and, on the TPU backend, its fused
+        size memos (backends/tpu/fused.py) — a poisoned entry must not
+        keep failing every future hit, and per-device caches mean the
+        eviction never touches another device's compiled state.
         Stamped on the handle so one request quarantines at most once
         (the ladder and a breaker trip must not double-count)."""
         req.handle.info["quarantined"] = True
         self._quarantines.inc()
-        session = self.session
+        session = replica.session
         try:
             key_fn = getattr(session, "_plan_cache_key", None)
             if key_fn is not None:
-                key = key_fn(req.graph, req.query, req.params)
+                graph = replica.graph_for(req.graph)
+                key = key_fn(graph, req.query, req.params)
                 if key is not None:
                     session.plan_cache.quarantine(key)
         except Exception:  # pragma: no cover — containment must not fail
@@ -550,15 +743,17 @@ class QueryServer:
         fused = getattr(session, "fused", None)
         if fused is not None:
             try:
-                # under the exec lock: the memo maps must not shrink
-                # under an in-flight fused run on another worker
-                with self._exec_lock:
-                    fused.forget(req.graph, req.query)
+                # under the replica's exec lock: the memo maps must not
+                # shrink under an in-flight fused run on this device
+                with replica.lock:
+                    graph = replica.graph_for(req.graph)
+                    fused.forget(graph, req.query)
             except Exception:  # pragma: no cover
                 pass
         tracer = session.tracer
         if tracer.enabled:
-            tracer.event("plan.quarantined", query=req.query)
+            tracer.event("plan.quarantined", query=req.query,
+                         device=replica.index)
 
     def _finish(self, req: Request, outcome: Any) -> None:
         """Materialize (deadline-checked) and complete one handle."""

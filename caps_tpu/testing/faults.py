@@ -15,6 +15,12 @@ This module provides them:
 * :func:`device_oom` — a realistic ``XlaRuntimeError``-shaped
   ``RESOURCE_EXHAUSTED``, injected at an operator boundary or into
   ingest placement;
+* :func:`device_loss` / :func:`sick_device` — device-SCOPED faults for
+  the fault-domain serving tier (serve/devices.py): a permanent
+  ``UNAVAILABLE`` stream (dead device) or a deterministic error-rate
+  trickle (flaky device), injected ONLY into the replica whose
+  ``executing_device_index()`` matches — other devices' operator
+  streams never see them;
 * :func:`flaky_ingest` — fail the first N table ingests of a session
   with a transient device error;
 * :func:`corrupt_shard` — silent data damage on one shard (digest /
@@ -74,13 +80,20 @@ def make_oom(note: str = "") -> BaseException:
 
 
 def _resolve_operator(op_name: str) -> type:
+    """Resolve ``"Filter"``/``"FilterOp"`` to its operator class.  Looks
+    in relational/ops.py first, then the satellite operator modules
+    (count_pattern's SpMV pushdown, var_expand) — a fault aimed at
+    ``"CountPattern"`` must hook the operator that actually executes
+    when the planner pushes an aggregate down."""
+    from caps_tpu.relational import count_pattern as CP
     from caps_tpu.relational import ops as R
+    from caps_tpu.relational import var_expand as VE
     cls_name = op_name if op_name.endswith("Op") else op_name + "Op"
-    cls = getattr(R, cls_name, None)
-    if cls is None or not isinstance(cls, type) \
-            or not issubclass(cls, R.RelationalOperator):
-        raise ValueError(f"unknown relational operator {op_name!r}")
-    return cls
+    for mod in (R, CP, VE):
+        cls = getattr(mod, cls_name, None)
+        if isinstance(cls, type) and issubclass(cls, R.RelationalOperator):
+            return cls
+    raise ValueError(f"unknown relational operator {op_name!r}")
 
 
 ExcSpec = Union[BaseException, Type[BaseException],
@@ -253,6 +266,76 @@ def failing_operator(op_name: str, exc: ExcSpec = None,
         if budget.take():
             _count_injection("failing_operator")
             raise _fresh_exception(exc)
+
+    with OPERATOR_PATCH.hooked(cls, hook):
+        yield budget
+
+
+def _make_device_down(device_index: int) -> BaseException:
+    """A fresh ``UNAVAILABLE`` in the shape a dead/preempted device
+    raises it (serve/failure.py classifies the status word TRANSIENT —
+    the retry lands on a DIFFERENT device — and ``device_fault`` counts
+    it against this device's health ladder)."""
+    cls = xla_runtime_error_class()
+    exc = cls(f"UNAVAILABLE: device {device_index} has halted; "
+              f"transport closed [injected device loss]")
+    exc.caps_device_fault = True
+    return exc
+
+
+@contextlib.contextmanager
+def device_loss(device_index: int, n_times: Optional[int] = None,
+                op_name: str = "Scan"):
+    """Kill ONE device replica: while active, every ``_compute`` of the
+    named operator (default ``Scan`` — every query plan scans) raises a
+    fresh device-``UNAVAILABLE`` error, but ONLY on the replica whose
+    ``serve.devices.executing_device_index()`` matches ``device_index``
+    — other replicas' operator streams are untouched, which is the
+    fault-domain isolation the multi-device soak asserts.
+
+    ``n_times=None`` (default) is a permanent loss: the device keeps
+    failing — including its background reinstate probes — until the
+    context exits, so the server must quarantine it and degrade to N-1
+    devices.  ``n_times=K`` is a K-shot glitch (the probe after it
+    heals the device).  Composable with :class:`FaultPlan`; yields the
+    injection budget (``.injected``)."""
+    cls = _resolve_operator(op_name)
+    budget = _Budget(n_times)
+
+    def hook(_op):
+        from caps_tpu.serve.devices import executing_device_index
+        if executing_device_index() != device_index:
+            return
+        if budget.take():
+            _count_injection("device_loss")
+            raise _make_device_down(device_index)
+
+    with OPERATOR_PATCH.hooked(cls, hook):
+        yield budget
+
+
+@contextlib.contextmanager
+def sick_device(device_index: int, error_rate: float = 0.2,
+                n_times: Optional[int] = None, op_name: str = "Scan"):
+    """A flaky (not dead) device replica: a deterministic ~``error_rate``
+    fraction of the named operator's executions ON THIS DEVICE fail once
+    with a transient device error (every ``round(1/error_rate)``-th
+    eligible invocation — the same deterministic spacing as
+    ``failing_operator(every_n=)``, so a single retry on another device
+    always heals).  Scoped by ``executing_device_index()`` like
+    :func:`device_loss`; yields the injection budget."""
+    if not 0.0 < error_rate <= 1.0:
+        raise ValueError(f"error_rate must be in (0, 1], got {error_rate}")
+    cls = _resolve_operator(op_name)
+    budget = _Budget(n_times, every_n=max(1, int(round(1.0 / error_rate))))
+
+    def hook(_op):
+        from caps_tpu.serve.devices import executing_device_index
+        if executing_device_index() != device_index:
+            return
+        if budget.take():
+            _count_injection("sick_device")
+            raise _make_device_down(device_index)
 
     with OPERATOR_PATCH.hooked(cls, hook):
         yield budget

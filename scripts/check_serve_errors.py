@@ -29,6 +29,17 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVE = os.path.join(REPO, "caps_tpu", "serve")
 
+#: the serve/ modules this lint MUST see — a rename/move that silently
+#: drops a module from the walk would turn the whole check vacuous for
+#: it, so missing expected files are findings, not skips.  New serve/
+#: modules are picked up automatically by the directory walk; add them
+#: here too so the coverage stays pinned.
+EXPECTED_MODULES = frozenset({
+    "__init__.py", "admission.py", "batcher.py", "breaker.py",
+    "deadline.py", "devices.py", "errors.py", "failure.py",
+    "request.py", "retry.py", "server.py",
+})
+
 
 def _raised_names(tree: ast.AST):
     """(lineno, name) for every ``raise Name(...)`` / ``raise Name``
@@ -55,9 +66,12 @@ def findings():
     sys.path.insert(0, REPO)
     from caps_tpu.serve.errors import ServeError
     out = []
-    for fname in sorted(os.listdir(SERVE)):
-        if not fname.endswith(".py"):
-            continue
+    present = {f for f in os.listdir(SERVE) if f.endswith(".py")}
+    for missing in sorted(EXPECTED_MODULES - present):
+        out.append(f"caps_tpu/serve/{missing}: expected serve module "
+                   f"is MISSING from the lint walk (moved/renamed? "
+                   f"update EXPECTED_MODULES)")
+    for fname in sorted(present):
         path = os.path.join(SERVE, fname)
         with open(path, encoding="utf-8") as f:
             tree = ast.parse(f.read(), filename=path)

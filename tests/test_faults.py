@@ -75,7 +75,9 @@ def _bag(rows):
 class FakeClock:
     """Monotonic fake for caps_tpu.obs.clock: ``sleep`` advances ``now``
     instantly and records what was slept (thread-safe — server workers
-    read it concurrently)."""
+    read it concurrently).  ``wait`` — the interruptible backoff
+    primitive — honors an already-fired event instantly (no time passes,
+    nothing recorded) and otherwise advances like a sleep."""
 
     def __init__(self, t0: float = 1_000.0):
         self._t = t0
@@ -91,6 +93,12 @@ class FakeClock:
             self._t += s
             self.sleeps.append(s)
 
+    def wait(self, event, timeout: float) -> bool:
+        if event.is_set():
+            return True
+        self.sleep(timeout)
+        return event.is_set()
+
     def advance(self, s: float) -> None:
         with self._lock:
             self._t += s
@@ -101,6 +109,7 @@ def fake_clock(monkeypatch):
     fc = FakeClock()
     monkeypatch.setattr(clock, "now", fc.now)
     monkeypatch.setattr(clock, "sleep", fc.sleep)
+    monkeypatch.setattr(clock, "wait", fc.wait)
     return fc
 
 
@@ -440,7 +449,7 @@ def test_poisoned_plan_quarantines_and_recovers_degraded():
     assert [r["n"] for r in rows] == ["Alice", "Bob", "Dana"]
     attempts = h.info["attempts"]
     assert attempts[0]["classified"] == POISONED_PLAN
-    assert attempts[1] == {"mode": "replan", "ok": True}
+    assert attempts[1] == {"mode": "replan", "ok": True, "device": 0}
     # the suspected entry was evicted (quarantined), not served again
     assert session.plan_cache.quarantined >= 1
     snap = session.metrics_snapshot()
@@ -596,13 +605,15 @@ def test_half_open_trial_is_single_probe(fake_clock):
     with failing_operator("OrderBy", exc=RuntimeError("poison"),
                           n_times=None):
         bad = server.submit(Q_ORDER, {"min": 30})
-        server._execute_batch(server.batcher.next_batch(timeout=0))
+        server._execute_batch(server.batcher.next_batch(timeout=0),
+                              server.devices.replicas[0])
         assert isinstance(bad.exception(), QueryFailed)
     assert server.health() == "degraded"
     # fault lifted; three same-family requests queue during cooldown
     handles = [server.submit(Q_ORDER, {"min": m}) for m in (30, 40, 20)]
     fake_clock.advance(10.0)
-    server._execute_batch(server.batcher.next_batch(timeout=0))
+    server._execute_batch(server.batcher.next_batch(timeout=0),
+                              server.devices.replicas[0])
     # one probe (batch of 1), then the siblings as one normal batch
     assert handles[0].info["batch_size"] == 1
     assert [h.info["batch_size"] for h in handles[1:]] == [2, 2]
@@ -623,11 +634,13 @@ def test_failed_half_open_probe_fast_fails_siblings(fake_clock):
     with failing_operator("OrderBy", exc=RuntimeError("poison"),
                           n_times=None):
         bad = server.submit(Q_ORDER, {"min": 30})
-        server._execute_batch(server.batcher.next_batch(timeout=0))
+        server._execute_batch(server.batcher.next_batch(timeout=0),
+                              server.devices.replicas[0])
         assert isinstance(bad.exception(), QueryFailed)
         handles = [server.submit(Q_ORDER, {"min": m}) for m in (30, 40)]
         fake_clock.advance(10.0)
-        server._execute_batch(server.batcher.next_batch(timeout=0))
+        server._execute_batch(server.batcher.next_batch(timeout=0),
+                              server.devices.replicas[0])
         # the probe failed again: it carries the real error, the sibling
         # fast-fails typed without touching the device
         assert isinstance(handles[0].exception(), QueryFailed)

@@ -220,7 +220,9 @@ def test_two_servers_share_one_session_exec_lock():
     graph = _graph(session)
     a = QueryServer(session, graph=graph)
     b = QueryServer(session, graph=graph)
-    assert a._exec_lock is b._exec_lock  # per-session, not per-server
+    # per-session, not per-server: both servers' replica 0 serializes
+    # through the one lock attached to the shared session
+    assert a.devices.replicas[0].lock is b.devices.replicas[0].lock
     ha = a.submit(QUERIES[0][0], {"min": 30})
     hb = b.submit(QUERIES[0][0], {"min": 40})
     assert [r["n"] for r in ha.rows(timeout=30)] == ["Alice", "Bob",
