@@ -19,7 +19,7 @@ Design constraints:
 * one clock — all timestamps come from :mod:`caps_tpu.obs.clock`
   (enforced by ``scripts/check_no_naked_timers.py``).
 """
-from caps_tpu.obs import clock
+from caps_tpu.obs import clock, lockgraph
 from caps_tpu.obs.export import (chrome_trace_events, write_chrome_trace,
                                  write_jsonl)
 from caps_tpu.obs.metrics import (MetricsRegistry, diff_snapshots,
@@ -30,7 +30,8 @@ from caps_tpu.obs.tracer import (NULL_SPAN, NullSpan, Span, Tracer, activate,
                                  active_tracer)
 
 __all__ = [
-    "clock", "Span", "NullSpan", "NULL_SPAN", "Tracer", "activate",
+    "clock", "lockgraph", "Span", "NullSpan", "NULL_SPAN", "Tracer",
+    "activate",
     "active_tracer", "MetricsRegistry", "global_registry", "diff_snapshots",
     "write_jsonl", "write_chrome_trace", "chrome_trace_events",
     "profile_tree", "render_profile", "tag_timing", "find_executed_rows",

@@ -24,8 +24,9 @@ plus a registry lock for get-or-create): the serving tier
 """
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+from caps_tpu.obs.lockgraph import make_lock
 
 Number = Union[int, float]
 
@@ -45,7 +46,7 @@ class Counter:
     def __init__(self, name: str):
         self.name = name
         self.value: Number = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Counter._lock")
 
     def inc(self, n: Number = 1) -> None:
         with self._lock:
@@ -97,7 +98,7 @@ class Histogram:
         self.max: Optional[float] = None
         # observe() updates five fields; a torn update (count bumped,
         # sum not) would corrupt mean/percentile math under concurrency
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Histogram._lock")
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -138,7 +139,7 @@ class MetricsRegistry:
         # guards the name→instrument maps (get-or-create races would
         # hand two threads two different Counter objects for one name,
         # silently splitting the count; snapshot() iterates the maps)
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.MetricsRegistry._lock")
 
     # -- get-or-create -------------------------------------------------
 

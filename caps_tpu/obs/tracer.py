@@ -26,6 +26,7 @@ import threading
 from typing import Any, Dict, Iterator, List, Optional
 
 from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_lock
 
 
 @dataclasses.dataclass
@@ -144,7 +145,7 @@ class Tracer:
         # finished roots funnel into the shared ``spans`` list under a
         # lock.
         self._tls = threading.local()
-        self._spans_lock = threading.Lock()
+        self._spans_lock = make_lock("tracer.Tracer._spans_lock")
         self.dropped = 0                # spans beyond max_spans
 
     @property

@@ -8,8 +8,9 @@ mount empty; SURVEY.md §2, §3.3).
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional, Tuple, Union
+
+from caps_tpu.obs.lockgraph import make_rlock
 
 from caps_tpu.okapi.graph import (
     GraphName, Namespace, PropertyGraph, PropertyGraphCatalog, QualifiedGraphName,
@@ -65,7 +66,7 @@ class CypherCatalog(PropertyGraphCatalog):
         # two serving threads interleaving mutations could leave the
         # fingerprint bumped with stale entries still cached.  Reentrant
         # because a listener may legitimately read the catalog back.
-        self._lock = threading.RLock()
+        self._lock = make_rlock("catalog.CypherCatalog._lock")
 
     def subscribe(self, fn) -> None:
         """Register a callback invoked with the new version after every

@@ -47,10 +47,11 @@ import threading
 from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 
+from caps_tpu.obs.lockgraph import make_lock, make_rlock
 from caps_tpu.okapi.types import from_python
 
 _plan_tokens = itertools.count(1)
-_plan_token_lock = threading.Lock()
+_plan_token_lock = make_lock("plan_cache._plan_token_lock")
 
 
 def graph_plan_token(graph) -> Optional[int]:
@@ -235,7 +236,9 @@ class CachedPlan:
     # result memos), so concurrent serving threads that hit the same
     # entry take turns — per-plan, not cache-wide (see session._run_cached).
     exec_lock: threading.Lock = dataclasses.field(
-        default_factory=threading.Lock, repr=False, compare=False)
+        default_factory=lambda: make_lock("plan_cache.CachedPlan"
+                                          ".exec_lock"),
+        repr=False, compare=False)
 
 
 def reset_plan(root) -> None:
@@ -289,7 +292,7 @@ class PlanCache:
         # Guards _entries/_count: lookup's LRU move_to_end, store's
         # append+evict, and the catalog-subscription eviction all mutate
         # the OrderedDict and may run on different serving threads.
-        self._lock = threading.RLock()
+        self._lock = make_rlock("plan_cache.PlanCache._lock")
         self.metrics = registry if registry is not None else MetricsRegistry()
         self._hits = self.metrics.counter("plan_cache.hits")
         self._misses = self.metrics.counter("plan_cache.misses")

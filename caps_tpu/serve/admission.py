@@ -22,11 +22,11 @@ and the admitted/shed counters land in the server's metrics registry
 """
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_condition, make_lock
 from caps_tpu.obs.metrics import MetricsRegistry
 from caps_tpu.serve.errors import Overloaded, ServerClosed
 from caps_tpu.serve.request import Request
@@ -35,7 +35,7 @@ from caps_tpu.serve.request import Request
 #: scheduling quantum rather than hot-loop on the server.
 _MIN_RETRY_S = 0.001
 
-_gauge_guard = threading.Lock()
+_gauge_guard = make_lock("admission._gauge_guard")
 
 
 def _register_depth_gauge(registry: MetricsRegistry,
@@ -69,7 +69,7 @@ class AdmissionController:
         self.max_queue = max(1, int(max_queue))
         self.per_priority_limits = dict(per_priority_limits or {})
         self.workers = max(1, int(workers))
-        self._cond = threading.Condition()
+        self._cond = make_condition("admission.AdmissionController._cond")
         self._queues: Dict[int, Deque[Request]] = {}
         self._depth = 0
         self._closed = False

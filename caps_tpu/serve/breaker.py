@@ -26,10 +26,10 @@ feeds ``QueryServer.stats()["health"]``.
 """
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional, Tuple
 
 from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_lock
 
 CLOSED = "closed"
 OPEN = "open"
@@ -66,7 +66,7 @@ class CircuitBreaker:
                  metric_prefix: str = "serve.breaker"):
         self.failure_threshold = max(1, int(failure_threshold))
         self.cooldown_s = float(cooldown_s)
-        self._lock = threading.Lock()
+        self._lock = make_lock("breaker.CircuitBreaker._lock")
         self._families: Dict[Any, _Family] = {}
         self._opened = registry.counter(f"{metric_prefix}.opened")
         self._closed_again = registry.counter(f"{metric_prefix}.closed")

@@ -44,6 +44,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_lock
 from caps_tpu.serve.breaker import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker)
 from caps_tpu.serve.deadline import cancel_scope
 from caps_tpu.serve.errors import ReplicationUnsupported
@@ -68,7 +69,7 @@ MAX_REPLICA_GRAPHS = 8
 
 _exec_tls = threading.local()
 
-_session_locks_guard = threading.Lock()
+_session_locks_guard = make_lock("devices._session_locks_guard")
 
 
 def executing_device_index() -> Optional[int]:
@@ -88,7 +89,7 @@ def _session_exec_lock(session) -> threading.Lock:
         with _session_locks_guard:
             lock = getattr(session, "_serve_exec_lock", None)
             if lock is None:
-                lock = threading.Lock()
+                lock = make_lock("devices.DeviceReplica.lock")
                 session._serve_exec_lock = lock
     return lock
 
@@ -161,7 +162,7 @@ class DeviceReplica:
         #: one dispatch stream per device: every execution on this
         #: replica (including cross-device retries and probes) holds it
         self.lock = _session_exec_lock(session)
-        self._stats_lock = threading.Lock()
+        self._stats_lock = make_lock("devices.DeviceReplica._stats_lock")
         self.requests = 0
         self.completed = 0
         self.failed = 0
@@ -173,7 +174,7 @@ class DeviceReplica:
         #: so a long-lived server cycling through many short-lived
         #: graphs cannot pin dead graphs' device buffers forever
         self._graphs: Dict[int, Tuple[Any, Any]] = {}
-        self._graphs_lock = threading.Lock()
+        self._graphs_lock = make_lock("devices.DeviceReplica._graphs_lock")
 
     @contextlib.contextmanager
     def activate(self):

@@ -43,6 +43,7 @@ import threading
 from typing import Any, Dict, List, Mapping, Optional
 
 from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_lock
 from caps_tpu.serve import batcher as _batcher
 from caps_tpu.serve.admission import AdmissionController
 from caps_tpu.serve.batcher import MicroBatcher
@@ -204,7 +205,8 @@ class QueryServer:
         #: cancels their scopes so backoff sleeps and engine checkpoints
         #: end them promptly
         self._inflight: set = set()
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = make_lock("server.QueryServer"
+                                        "._inflight_lock")
         if start:
             self.start()
 

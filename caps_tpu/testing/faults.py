@@ -46,13 +46,13 @@ per-member isolation contract depends on it (tests/test_faults.py).
 from __future__ import annotations
 
 import contextlib
-import threading
 import warnings
 from typing import Callable, Dict, List, Optional, Type, Union
 
 import jax.numpy as jnp
 
 from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_lock, make_rlock
 from caps_tpu.obs.metrics import global_registry
 
 
@@ -125,7 +125,7 @@ class _Budget:
     def __init__(self, n_times: Optional[int], every_n: int = 1):
         self._n = n_times
         self._every = max(1, int(every_n))
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults._Budget._lock")
         self._calls = 0
         self.injected = 0
 
@@ -154,7 +154,7 @@ class _OperatorPatch:
     exits, however the contexts were nested or threaded."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("faults._OperatorPatch._lock")
         self._originals: Dict[type, Callable] = {}
         self._hooks: Dict[type, List[Callable]] = {}
 

@@ -1,57 +1,31 @@
 #!/usr/bin/env python3
-"""Lint: no naked timers inside ``caps_tpu/``.
+"""Lint shim: no naked timers inside ``caps_tpu/`` — all timing reads
+go through ``caps_tpu.obs.clock``.
 
-All timing reads must go through ``caps_tpu.obs.clock`` (the single
-monotonic base every span, operator metric, and trace export shares —
-ISSUE 3 satellite).  This script greps ``caps_tpu/`` for
-``time.perf_counter(`` / ``time.time(`` calls outside ``caps_tpu/obs/``
-(aliased imports like ``import time as _time`` are caught too: the
-pattern matches the attribute access, not the import name binding).
-
-Exit status: 0 clean, 1 with findings (one ``path:line: text`` per
-offence).  Run standalone or via the CI workflow.
+This script is now a thin delegate to capslint's ``clock-discipline``
+pass (``python -m caps_tpu.analysis --only clock-discipline``), which
+replaces the old regex with AST import resolution and closes the
+``from time import perf_counter`` hole (a name import never produces a
+``time.`` attribute access for a regex to match).  Same contract as
+before: exit 0 clean / 1 with findings, one indented ``path:line:
+message`` per offence.  Prefer running capslint directly.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "caps_tpu")
-EXEMPT = os.path.join(PKG, "obs") + os.sep
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-# matches `time.perf_counter(` / `time.time(` including aliased modules
-# (`_time.perf_counter(`) — any attribute access ending in these names
-PATTERN = re.compile(r"time\.(?:perf_counter|time)\s*\(")
-
-
-def findings():
-    out = []
-    for root, _dirs, files in os.walk(PKG):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            if path.startswith(EXEMPT):
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if PATTERN.search(line):
-                        rel = os.path.relpath(path, REPO)
-                        out.append(f"{rel}:{lineno}: {line.strip()}")
-    return out
+from caps_tpu.analysis import run_shim  # noqa: E402
 
 
 def main() -> int:
-    bad = findings()
-    if bad:
-        print("naked timers found (use caps_tpu.obs.clock instead):")
-        for b in bad:
-            print(f"  {b}")
-        return 1
-    print("check_no_naked_timers: clean")
-    return 0
+    return run_shim(
+        "clock-discipline",
+        header="naked timers found (use caps_tpu.obs.clock instead):",
+        clean_message="check_no_naked_timers: clean")
 
 
 if __name__ == "__main__":

@@ -1,106 +1,32 @@
 #!/usr/bin/env python3
-"""Lint: every exception constructed and raised inside ``caps_tpu/serve/``
-inherits :class:`caps_tpu.serve.errors.ServeError`.
+"""Lint shim: every exception raised inside ``caps_tpu/serve/`` inherits
+:class:`caps_tpu.serve.errors.ServeError`.
 
-The serving tier's client contract (docs/guide.md "Failure handling") is
-that ONE except clause — ``except ServeError`` — catches everything the
-tier itself can signal: shedding, deadlines, cancellation, retry
-give-ups, breaker fast-fails, wait timeouts.  A stray ``raise
-TimeoutError(...)`` silently breaks that contract for every client, so
-this script walks the AST of each ``caps_tpu/serve/*.py`` file, finds
-``raise SomeName(...)`` statements, resolves ``SomeName`` against the
-module's imported/defined names, and fails unless the resolved class
-subclasses ``ServeError``.
-
-Skipped (not statically checkable, and legitimately outside the
-contract): bare ``raise`` re-raises and ``raise some_variable`` — e.g.
-``QueryHandle.result`` re-raising the ENGINE's error, which is the
-client's query failing, not the serving tier signalling.
-
-Exit status: 0 clean, 1 with findings.  Run standalone or via CI.
+This script is now a thin delegate to capslint's ``error-taxonomy``
+pass (``python -m caps_tpu.analysis --only error-taxonomy``), which
+carries the original check — AST-resolved, no package import needed —
+plus the PR 4 extensions (exception-mutation discipline, swallowed
+broad handlers, the worker path routing through ``failure.classify``).
+Same contract as before: exit 0 clean / 1 with findings, one indented
+``path:line: message`` per offence.  Prefer running capslint directly.
 """
 from __future__ import annotations
 
-import ast
-import importlib
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SERVE = os.path.join(REPO, "caps_tpu", "serve")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-#: the serve/ modules this lint MUST see — a rename/move that silently
-#: drops a module from the walk would turn the whole check vacuous for
-#: it, so missing expected files are findings, not skips.  New serve/
-#: modules are picked up automatically by the directory walk; add them
-#: here too so the coverage stays pinned.
-EXPECTED_MODULES = frozenset({
-    "__init__.py", "admission.py", "batcher.py", "breaker.py",
-    "deadline.py", "devices.py", "errors.py", "failure.py",
-    "request.py", "retry.py", "server.py",
-})
-
-
-def _raised_names(tree: ast.AST):
-    """(lineno, name) for every ``raise Name(...)`` / ``raise Name``
-    with a plain-name callee.  Raises inside a ``__getattr__`` are
-    exempt: the module/attribute protocol REQUIRES AttributeError there
-    (it signals "name not exported", not a serving failure)."""
-    exempt = set()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name == "__getattr__":
-            exempt.update(id(n) for n in ast.walk(node))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Raise) or node.exc is None \
-                or id(node) in exempt:
-            continue
-        exc = node.exc
-        if isinstance(exc, ast.Call):
-            exc = exc.func
-        if isinstance(exc, ast.Name):
-            yield node.lineno, exc.id
-
-
-def findings():
-    sys.path.insert(0, REPO)
-    from caps_tpu.serve.errors import ServeError
-    out = []
-    present = {f for f in os.listdir(SERVE) if f.endswith(".py")}
-    for missing in sorted(EXPECTED_MODULES - present):
-        out.append(f"caps_tpu/serve/{missing}: expected serve module "
-                   f"is MISSING from the lint walk (moved/renamed? "
-                   f"update EXPECTED_MODULES)")
-    for fname in sorted(present):
-        path = os.path.join(SERVE, fname)
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-        module = importlib.import_module(
-            f"caps_tpu.serve.{fname[:-3]}" if fname != "__init__.py"
-            else "caps_tpu.serve")
-        rel = os.path.relpath(path, REPO)
-        for lineno, name in _raised_names(tree):
-            obj = getattr(module, name, None)
-            if obj is None:
-                out.append(f"{rel}:{lineno}: raises unresolvable "
-                           f"name {name!r}")
-            elif not (isinstance(obj, type)
-                      and issubclass(obj, ServeError)):
-                out.append(f"{rel}:{lineno}: raises {name}, which does "
-                           f"not inherit ServeError")
-    return out
+from caps_tpu.analysis import run_shim  # noqa: E402
 
 
 def main() -> int:
-    bad = findings()
-    if bad:
-        print("serve/ raises non-ServeError exceptions "
-              "(clients must be able to catch ONE base type):")
-        for b in bad:
-            print(f"  {b}")
-        return 1
-    print("check_serve_errors: clean")
-    return 0
+    return run_shim(
+        "error-taxonomy",
+        header="serve/ raises non-ServeError exceptions "
+               "(clients must be able to catch ONE base type):",
+        clean_message="check_serve_errors: clean")
 
 
 if __name__ == "__main__":
