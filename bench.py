@@ -37,6 +37,11 @@ Modes: ``python bench.py``           config 1 (2-hop foaf)
        ``python bench.py faults``    config 6 (serve under injected
                                      transient faults: availability,
                                      retry overhead, breaker behavior)
+       ``python bench.py updates``   config 8 (live updates: 8-client
+                                     mixed read/write soak under ~20%
+                                     injected write aborts — availability,
+                                     reader digest stability, compaction
+                                     backlog; --write-fraction F)
 """
 from __future__ import annotations
 
@@ -861,6 +866,135 @@ def run_faults_config(on_tpu: bool):
     _emit()
 
 
+def run_updates_config(on_tpu: bool):
+    """Benchmark config 8: live graph updates under serving load
+    (ISSUE 8 — snapshot isolation + failure-atomic writes).
+
+    8 closed-loop clients run a mixed read/write workload (write
+    fraction configurable, default ~25%) against ONE versioned graph
+    behind a QueryServer with the background compactor enabled, while
+    ``abort_write`` injects transient aborts into ~20% of write
+    commits.
+
+    value = availability: the fraction of requests that resolved to a
+    correct result or a typed ServeError.  reader_digest_stable = every
+    reader's rows equal the serial state at its admission-time snapshot
+    version (zero torn reads).  Also reports write/read p50, commit and
+    rollback counts, compactions completed under load, and the final
+    compaction backlog.
+    """
+    import threading as _th
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.obs import diff_snapshots
+    from caps_tpu.relational.updates import versioned
+    from caps_tpu.serve import (QueryServer, RetryPolicy, ServeError,
+                                ServerConfig)
+    from caps_tpu.testing.faults import abort_write
+    from caps_tpu.testing.factory import create_graph
+
+    _result.update({"metric": "mixed read/write availability "
+                              "(no measurement completed)",
+                    "unit": "fraction", "value": 0.0})
+    wf = 0.25
+    if "--write-fraction" in sys.argv:
+        i = sys.argv.index("--write-fraction")
+        if i + 1 < len(sys.argv):
+            wf = float(sys.argv[i + 1])
+    every_write = max(2, int(round(1.0 / max(wf, 0.01))))
+    clients = 8
+    per_client = int(os.environ.get("BENCH_UPDATE_REQS",
+                                    "40" if on_tpu else "25"))
+    total = clients * per_client
+
+    session = TPUCypherSession()
+    vg = versioned(session, create_graph(
+        session, "CREATE (:Seed {k:-1, v:-1})"))
+    server = QueryServer(session, graph=vg, config=ServerConfig(
+        workers=2, max_queue=4096,
+        retry=RetryPolicy(max_attempts=5, backoff_base_s=0.002,
+                          backoff_max_s=0.05),
+        compaction_threshold_rows=16, compaction_interval_s=0.005))
+
+    write_log, observations, failures = {}, [], []
+    log_lock = _th.Lock()
+    write_lat, read_lat = [], []
+
+    def client(i):
+        for j in range(per_client):
+            is_write = (i * per_client + j) % every_write == 0
+            try:
+                if is_write:
+                    k = i * 100_000 + j
+                    h = server.submit("CREATE (:Item {k:$k, v:$v})",
+                                      {"k": k, "v": k * 7})
+                    res = h.result(timeout=60)
+                    with log_lock:
+                        write_log[res.metrics["snapshot_version"]] = \
+                            (k, k * 7)
+                        write_lat.append(h.info["latency_s"])
+                else:
+                    h = server.submit(
+                        "MATCH (n:Item) RETURN n.k AS k, n.v AS v")
+                    rows = h.rows(timeout=60)
+                    with log_lock:
+                        observations.append(
+                            (h.info["snapshot_version"],
+                             frozenset((r["k"], r["v"]) for r in rows)))
+                        read_lat.append(h.info["latency_s"])
+            except ServeError:
+                pass  # typed shed/deadline: availability still holds
+            except Exception as ex:
+                failures.append((i, j, type(ex).__name__, str(ex)[:120]))
+
+    snap0 = session.metrics_snapshot()
+    threads = [_th.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    with abort_write(session, after_n_columns=1, n_times=None,
+                     every_n=5) as budget:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    elapsed = time.perf_counter() - t0
+    delta = diff_snapshots(snap0, session.metrics_snapshot())
+    server.shutdown()
+
+    torn = 0
+    for version, seen in observations:
+        expected = frozenset(kv for v, kv in write_log.items()
+                             if v <= version)
+        if seen != expected:
+            torn += 1
+    resolved = total - len(failures)
+    availability = resolved / total if total else 0.0
+
+    _result.update({
+        "metric": f"availability, 8-client mixed read/write soak "
+                  f"(~{round(100 / every_write)}% writes, ~20% write "
+                  f"aborts injected, "
+                  f"{'tpu' if on_tpu else 'cpu-fallback'})",
+        "value": round(availability, 4),
+        "unit": "fraction",
+        "vs_baseline": 1.0,
+        "qps": round(total / elapsed, 1) if elapsed else 0.0,
+        "writes_committed": len(write_log),
+        "write_aborts_injected": budget.injected,
+        "write_rollbacks": delta.get("updates.rolled_back", 0),
+        "write_retries": delta.get("serve.retries", 0),
+        "reader_digest_stable": torn == 0,
+        "torn_reads": torn,
+        "reads_observed": len(observations),
+        "write_p50_s": _percentiles(write_lat).get("p50_s", 0.0),
+        "read_p50_s": _percentiles(read_lat).get("p50_s", 0.0),
+        "compactions_under_load": delta.get("compaction.runs", 0),
+        "compaction_conflicts": delta.get("compaction.conflicts", 0),
+        "compaction_backlog_rows": vg.delta_rows(),
+        "untyped_failures": failures[:5],
+    })
+    _emit()
+
+
 def main():
     import numpy as np
     _install_guards()
@@ -881,6 +1015,8 @@ def main():
         return run_serve_config(on_tpu)
     if len(sys.argv) > 1 and sys.argv[1] == "faults":
         return run_faults_config(on_tpu)
+    if len(sys.argv) > 1 and sys.argv[1] == "updates":
+        return run_updates_config(on_tpu)
 
     from caps_tpu.backends.local.session import LocalCypherSession
     from caps_tpu.backends.tpu.session import TPUCypherSession

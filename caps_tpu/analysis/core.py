@@ -40,8 +40,8 @@ BANNED_TIME_READS = frozenset({
 #: over from scripts/check_serve_errors.py).
 DEFAULT_SERVE_MODULES = frozenset({
     "__init__.py", "admission.py", "batcher.py", "breaker.py",
-    "deadline.py", "devices.py", "errors.py", "failure.py",
-    "request.py", "retry.py", "server.py",
+    "compaction.py", "deadline.py", "devices.py", "errors.py",
+    "failure.py", "request.py", "retry.py", "server.py",
 })
 
 
@@ -97,7 +97,8 @@ class AnalysisConfig:
     #: sanctioned first segments of dotted metric names
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
-        "faults", "fused", "dist_join", "obs", "backend", "tracer"})
+        "faults", "fused", "dist_join", "obs", "backend", "tracer",
+        "updates", "compaction"})
     #: extra tracer-purity roots: every method with one of these names in
     #: the listed dirs is treated as reached by the fused record path
     #: (operator ``_compute`` bodies are recorded and replayed — clock

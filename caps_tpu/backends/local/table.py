@@ -186,6 +186,15 @@ class LocalTable(Table):
         return self._with(self._columns, data, types,
                           size=self._size + other._size)
 
+    def drop_in(self, col: str, values) -> "LocalTable":
+        dropped = frozenset(values)
+        if not dropped:
+            return self
+        vals = self._data[col]
+        keep = [i for i in range(self._size)
+                if vals[i] is None or vals[i] not in dropped]
+        return self._take(keep)
+
     def distinct(self) -> "LocalTable":
         seen = set()
         keep = []

@@ -43,8 +43,13 @@ class TPUCypherSession(RelationalCypherSession):
         result's metrics as per-query deltas."""
         be = self.backend
         # degraded unfused mode (relational/session.py, serve/ failure
-        # containment): per-operator eager execution, no memo touched
-        use_fused = self.config.use_fused and not degraded_state()[1]
+        # containment): per-operator eager execution, no memo touched.
+        # Update statements NEVER fuse: their effect is a commit, not a
+        # replayable size stream — recording one under the handle's key
+        # would replay stale sizes over changed data.
+        from caps_tpu.relational.updates import is_update_query
+        use_fused = (self.config.use_fused and not degraded_state()[1]
+                     and not is_update_query(query))
         before = (be.ici_bytes, be.dist_joins, be.broadcast_joins,
                   be.fallbacks, be.syncs, be.ici_payload_bytes,
                   be.salted_joins, self.fused.generic_replays

@@ -628,6 +628,27 @@ class DeviceTable(Table):
         mask = pred.data & pred.valid & self.row_ok
         return self._compact(mask)
 
+    def drop_in(self, col: str, values) -> "DeviceTable":
+        """Tombstone mask (relational/updates.py snapshot overlay): drop
+        rows whose ``col`` is in ``values``, entirely on-device.  The id
+        set is padded to a size bucket with a never-matching sentinel,
+        so the compiled isin+compact program is shared across snapshots
+        whose tombstone counts land in the same bucket — the
+        pad-and-mask discipline, applied to deletes."""
+        vals = sorted(int(v) for v in values)
+        if not vals:
+            return self
+        if self._local is not None:
+            return self._wrap_local(self._local.drop_in(col, vals))
+        c = self._cols[col]
+        cap = self.backend.bucket(len(vals))
+        # pad by repeating a real entry: duplicates change nothing, and
+        # no sentinel value needs to be reserved in the id domain
+        padded = np.full(cap, vals[0], dtype=np.int64)
+        padded[:len(vals)] = vals
+        hit = jnp.isin(c.data, jnp.asarray(padded)) & c.valid
+        return self._compact(self.row_ok & ~hit)
+
     def _compact(self, mask: jnp.ndarray) -> "DeviceTable":
         count = K.mask_count(mask)
         new_n, live = self.backend.consume_rows(count)

@@ -57,28 +57,57 @@ class CypherCatalog(PropertyGraphCatalog):
         self._sources: Dict[Namespace, PropertyGraphDataSource] = {
             Namespace(): SessionGraphDataSource()
         }
-        # bumped on every mutation; part of the fused executor's plan key
-        # and the session plan cache's catalog fingerprint
+        # bumped on every mutation (observability / coarse fingerprint)
         self.version = 0
+        # scoped dependency tokens (relational/plan_cache.py): one
+        # counter per qualified name, plus one per namespace for
+        # register/deregister — a mutation invalidates exactly the
+        # mutated name's dependents, never the whole plan cache
+        self._name_versions: Dict[QualifiedGraphName, int] = {}
+        self._ns_epochs: Dict[Namespace, int] = {}
         self._listeners: list = []
         # Serializes mutations: store/delete + the version bump + the
         # subscription fan-out (plan-cache eviction) must be atomic, or
         # two serving threads interleaving mutations could leave the
-        # fingerprint bumped with stale entries still cached.  Reentrant
+        # token bumped with stale entries still cached.  Reentrant
         # because a listener may legitimately read the catalog back.
         self._lock = make_rlock("catalog.CypherCatalog._lock")
 
     def subscribe(self, fn) -> None:
-        """Register a callback invoked with the new version after every
-        catalog mutation (the session plan cache evicts dependent
-        entries through this)."""
+        """Register a callback invoked as ``fn(version, qgn)`` after
+        every catalog mutation — ``qgn`` is the mutated qualified name,
+        or None for a namespace-level change (register/deregister).
+        The session plan cache evicts the mutated name's dependents
+        through this (scoped — unrelated graphs' plans survive)."""
         with self._lock:
             self._listeners.append(fn)
 
-    def _bump(self) -> None:
+    def dep_token(self, name: NameLike) -> Tuple[int, int]:
+        """The scoped consistency token a cached plan records per
+        resolved catalog graph: (namespace epoch, per-name version).
+        Any mutation of the name — or of its namespace's source set —
+        changes the token, and lookup revalidation drops the plan.
+
+        Deliberately LOCK-FREE: the plan cache validates tokens while
+        holding its own lock, and catalog mutations fan out INTO the
+        plan cache while holding this one — taking the catalog lock
+        here would close a lock-order cycle (the runtime lock graph
+        caught exactly that).  The two dict reads are each atomic under
+        the GIL and only ever mutated under the catalog lock; a lookup
+        that races a mutation reads the pre-mutation token, which is
+        indistinguishable from the lookup having happened just before
+        the mutation — and the mutation's eager eviction fan-out drops
+        the entry right after."""
+        qgn = _qualify(name)
+        return (self._ns_epochs.get(qgn.namespace, 0),
+                self._name_versions.get(qgn, 0))
+
+    def _bump(self, qgn: Optional[QualifiedGraphName] = None) -> None:
         self.version += 1
+        if qgn is not None:
+            self._name_versions[qgn] = self._name_versions.get(qgn, 0) + 1
         for fn in list(self._listeners):
-            fn(self.version)
+            fn(self.version, qgn)
 
     @property
     def session_namespace(self) -> Namespace:
@@ -91,6 +120,8 @@ class CypherCatalog(PropertyGraphCatalog):
             if namespace in self._sources:
                 raise ValueError(f"namespace {namespace!r} already registered")
             self._sources[namespace] = source
+            self._ns_epochs[namespace] = \
+                self._ns_epochs.get(namespace, 0) + 1
             self._bump()
 
     def deregister_source(self, namespace: Namespace) -> None:
@@ -100,7 +131,11 @@ class CypherCatalog(PropertyGraphCatalog):
             raise ValueError("cannot deregister the session namespace")
         with self._lock:
             if self._sources.pop(namespace, None) is not None:
-                self._bump()  # resolvable graphs changed: dependents are stale
+                # resolvable graphs changed: every name in the namespace
+                # is stale — the epoch bump flips all their dep tokens
+                self._ns_epochs[namespace] = \
+                    self._ns_epochs.get(namespace, 0) + 1
+                self._bump()
 
     def source(self, namespace: Namespace) -> PropertyGraphDataSource:
         if isinstance(namespace, str):
@@ -128,13 +163,13 @@ class CypherCatalog(PropertyGraphCatalog):
         qgn = _qualify(name)
         with self._lock:
             self.source(qgn.namespace).store(qgn.graph_name, graph)
-            self._bump()
+            self._bump(qgn)
 
     def delete(self, name: NameLike) -> None:
         qgn = _qualify(name)
         with self._lock:
             self.source(qgn.namespace).delete(qgn.graph_name)
-            self._bump()
+            self._bump(qgn)
 
     def graph_names(self) -> Tuple[QualifiedGraphName, ...]:
         out = []

@@ -119,6 +119,19 @@ def _align_rel_scan(rt: RelationshipTable, header: RecordHeader, var: str) -> Ta
     return t.select(list(header.columns))
 
 
+def align_scan(header: RecordHeader, t: Table) -> Table:
+    """Align a sub-scan to a wider union header: missing label columns
+    become False (the label is not possible in that part), other missing
+    columns null — the UnionGraph technique, shared with the versioned
+    snapshot overlay (relational/updates.py)."""
+    for e in header.exprs:
+        col = header.column(e)
+        if col not in t.columns:
+            default = False if isinstance(e, E.HasLabel) else None
+            t = t.with_literal_column(col, default, header.type_of(e))
+    return t.select(list(header.columns))
+
+
 class ScanGraph(RelationalCypherGraph):
     """A graph stored as one table per label-combination / relationship type."""
 
@@ -265,16 +278,7 @@ class UnionGraph(RelationalCypherGraph):
 
     def _union_scans(self, header: RecordHeader,
                      scans: List[Tuple[RecordHeader, Table]]) -> Table:
-        parts = []
-        for sub_header, t in scans:
-            # align sub-scan to the union header: missing label columns are
-            # False (the label is not possible there), other columns null
-            for e in header.exprs:
-                col = header.column(e)
-                if col not in t.columns:
-                    default = False if isinstance(e, E.HasLabel) else None
-                    t = t.with_literal_column(col, default, header.type_of(e))
-            parts.append(t.select(list(header.columns)))
+        parts = [align_scan(header, t) for _sub_header, t in scans]
         out = parts[0]
         for p in parts[1:]:
             out = out.union_all(p)

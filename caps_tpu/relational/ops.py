@@ -345,6 +345,15 @@ class ScanOp(RelationalOperator):
         self.entity_type = entity_type
 
     def _compute(self):
+        # delta-aware scan: against a versioned snapshot
+        # (relational/updates.py) the scan is (base minus tombstone
+        # mask) ∪ delta — surface the overlay size in this op's metrics
+        # so PROFILE and the op log attribute the extra work honestly
+        state = getattr(self.graph, "state", None)
+        if state is not None and getattr(state, "delta_rows", 0):
+            self._metric_extra = {
+                "delta_rows": state.delta_rows,
+                "snapshot_version": self.graph.snapshot_version}
         m = self.entity_type.material
         if isinstance(m, _CTNode):
             return self.graph.scan_node(self.var, m.labels)

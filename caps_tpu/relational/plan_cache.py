@@ -13,8 +13,9 @@ This module caches the *planned relational operator tree* and re-executes
 it with fresh parameter bindings:
 
 * the cache key is value-independent: (normalized query text, graph plan
-  token, catalog fingerprint, parameter *signature* — names + coarse
-  types, never values);
+  token, parameter *signature* — names + coarse types, never values);
+  catalog consistency rides on per-plan dependency tokens, revalidated
+  at lookup (scoped invalidation instead of a global fingerprint);
 * parameter VALUES are late-bound: relational operators read
   ``context.parameters`` inside ``_compute`` (SKIP/LIMIT counts,
   predicate params, percentile args all evaluate at execution time), so
@@ -24,8 +25,9 @@ it with fresh parameter bindings:
   properties), the cached entry is additionally keyed by that value
   aspect, so specialized plans are re-planned rather than served stale;
 * ``CATALOG CREATE/DROP GRAPH`` (and any catalog mutation) bumps the
-  catalog fingerprint — stale entries can never be served, and the
-  session's catalog subscription evicts them eagerly.
+  mutated NAME's dep token — its dependents can never be served (the
+  lookup revalidation drops them), the session's catalog subscription
+  evicts them eagerly, and every unrelated graph's plans survive.
 
 Executing a cached plan = clear each operator's memoized ``(header,
 table)`` pair, swap the shared runtime context's parameter dict, and pull
@@ -60,7 +62,14 @@ def graph_plan_token(graph) -> Optional[int]:
     executor's graph epoch).  None = this graph cannot anchor a cache
     entry.  The first-use stamp is locked: concurrent serving threads
     submitting against a fresh graph must agree on ONE token, or their
-    cache keys (and micro-batch keys) silently diverge."""
+    cache keys (and micro-batch keys) silently diverge.
+
+    A ``plan_token_unstable`` marker (the VersionedGraph handle —
+    relational/updates.py) refuses a token outright: the object's DATA
+    changes across commits, so a stable token would serve stale plans.
+    Readers anchor on the immutable per-version snapshots instead."""
+    if getattr(graph, "plan_token_unstable", False):
+        return None
     tok = getattr(graph, "_plan_token", None)
     if tok is None:
         with _plan_token_lock:
@@ -231,6 +240,11 @@ class CachedPlan:
     spec_key: Tuple                 # value specializations (see PlanParams)
     cold_phase_s: float             # parse+ir+plan+relational of the cold run
     nbytes: int                     # rough host-side footprint estimate
+    #: catalog graphs this plan resolved at planning time, with the
+    #: per-name dep token observed then: ((qgn, token), ...).  Lookup
+    #: revalidates against the live catalog, so a mutation of graph X
+    #: invalidates exactly X's dependents — never the whole cache.
+    catalog_deps: Tuple = ()
     # Serializes executions of THIS plan: the operator tree and its
     # runtime context are shared mutable state (parameter dict, per-op
     # result memos), so concurrent serving threads that hit the same
@@ -270,10 +284,14 @@ def _plan_nbytes(plan: Dict[str, str], root) -> int:
 class PlanCache:
     """Session-level LRU cache of :class:`CachedPlan` entries.
 
-    Keyed by (normalized query text, graph plan token, catalog
-    fingerprint, parameter signature); each key holds the (usually one)
-    plans that differ only in recorded value specializations.  LRU order
-    and the size cap count individual plans.
+    Keyed by (normalized query text, graph plan token, parameter
+    signature); each key holds the (usually one) plans that differ only
+    in recorded value specializations.  Catalog consistency is per-plan,
+    not per-key: each plan carries the dep tokens of the catalog graphs
+    it resolved (``catalog_deps``), revalidated on lookup — so a catalog
+    mutation invalidates exactly its dependents instead of fingerprinting
+    every key in the session (the old evict-everything fanout).  LRU
+    order and the size cap count individual plans.
 
     Counters live in a :class:`caps_tpu.obs.metrics.MetricsRegistry`
     (the session passes its own), so ``plan_cache.*`` shows up in
@@ -327,12 +345,22 @@ class PlanCache:
     def saved_s(self) -> float:
         return self._saved_s.value
 
-    def lookup(self, key: Tuple,
-               params: Mapping[str, Any]) -> Optional[CachedPlan]:
+    def lookup(self, key: Tuple, params: Mapping[str, Any],
+               catalog=None) -> Optional[CachedPlan]:
         with self._lock:
             plans = self._entries.get(key)
             if plans:
-                for plan in plans:
+                for plan in list(plans):
+                    if plan.catalog_deps and catalog is not None \
+                            and any(catalog.dep_token(q) != tok
+                                    for q, tok in plan.catalog_deps):
+                        # a referenced catalog graph changed since this
+                        # plan was made: scoped invalidation — drop just
+                        # this plan, the caller replans
+                        plans.remove(plan)
+                        self._count -= 1
+                        self._invalidations.inc()
+                        continue
                     if not plan.spec_key:
                         match = True
                     else:
@@ -343,6 +371,8 @@ class PlanCache:
                         self._hits.inc()
                         self._saved_s.inc(plan.cold_phase_s)
                         return plan
+                if not plans:
+                    del self._entries[key]
         self._misses.inc()
         return None
 
@@ -382,17 +412,44 @@ class PlanCache:
     def quarantined(self) -> int:
         return self._quarantined.value
 
-    def evict_stale(self, catalog_version: int) -> int:
-        """Explicit invalidation: drop every entry planned under an older
-        catalog fingerprint (key position 2).  Such entries could never
-        be served again — the fingerprint is part of the key — but
-        eager eviction frees the plans (and the graphs they pin)."""
+    def evict_dependents(self, qgn=None) -> int:
+        """Scoped catalog eviction (the session's catalog subscription):
+        drop exactly the plans that resolved the mutated graph ``qgn``
+        at planning time.  ``qgn=None`` (a namespace-level change —
+        register/deregister) drops every plan with ANY catalog
+        dependency.  Plans that never touched the catalog — the vast
+        majority of serving traffic — survive untouched."""
+        dropped = 0
         with self._lock:
-            stale = [k for k in self._entries if k[2] != catalog_version]
+            for k in list(self._entries):
+                plans = self._entries[k]
+                for plan in list(plans):
+                    deps = plan.catalog_deps
+                    if deps and (qgn is None
+                                 or any(q == qgn for q, _tok in deps)):
+                        plans.remove(plan)
+                        self._count -= 1
+                        self._invalidations.inc()
+                        dropped += 1
+                if not plans:
+                    del self._entries[k]
+        return dropped
+
+    def evict_graph(self, graph_token) -> int:
+        """Scoped per-graph eviction: drop every plan anchored on this
+        graph plan token (key position 1).  The versioned write path
+        uses it to free a superseded snapshot's plans the moment the
+        next version publishes — no other graph's entries are
+        touched."""
+        with self._lock:
+            stale = [k for k in self._entries if k[1] == graph_token]
+            n = 0
             for k in stale:
-                self._count -= len(self._entries.pop(k))
-                self._invalidations.inc()
-            return len(stale)
+                n += len(self._entries.pop(k))
+            self._count -= n
+            if n:
+                self._invalidations.inc(n)
+            return n
 
     def clear(self) -> None:
         with self._lock:

@@ -43,6 +43,10 @@ from caps_tpu.relational.plan_cache import (
 )
 from caps_tpu.relational.planner import RelationalPlanner
 from caps_tpu.relational.table import Table, TableFactory
+from caps_tpu.relational.updates import (
+    UpdateError, VersionedGraph, describe_plan, is_update_statement,
+    plan_update, stage_rows,
+)
 from caps_tpu.serve.deadline import cancel_scope, checkpoint
 
 
@@ -322,7 +326,14 @@ class RelationalCypherSession(CypherSession):
         self.plan_cache = PlanCache(self.config.plan_cache_size,
                                     enabled=self.config.use_plan_cache,
                                     registry=self.metrics_registry)
-        self._catalog.subscribe(self.plan_cache.evict_stale)
+        # Scoped catalog eviction: a mutation of graph X drops exactly
+        # X's dependents from the plan cache (okapi/catalog.py
+        # dep_token) — unrelated graphs' cached plans survive.
+        self._catalog.subscribe(
+            lambda _version, qgn: self.plan_cache.evict_dependents(qgn))
+        # per-thread recorder of catalog graphs resolved while planning
+        # (they become the cached plan's catalog_deps)
+        self._deps_tls = threading.local()
 
     # -- backend SPI --------------------------------------------------------
 
@@ -410,6 +421,15 @@ class RelationalCypherSession(CypherSession):
         # entries as the plain query (and vice versa), never a poisoned
         # key.
         mode, body = query_mode(query)
+        if isinstance(graph, VersionedGraph):
+            # snapshot isolation: a READ resolves the mutable handle to
+            # the latest committed snapshot ONCE, here, and runs on it
+            # end to end — commits that land meanwhile are invisible.
+            # Writes keep the handle (they serialize on its commit
+            # lock); so does EXPLAIN of a write.
+            from caps_tpu.relational.updates import is_update_query
+            if not is_update_query(body if mode is not None else query):
+                graph = graph.current()
         if mode == "explain":
             return self._explain_on_graph(graph, body, parameters)
         if mode == "profile":
@@ -476,6 +496,25 @@ class RelationalCypherSession(CypherSession):
         with self._observed(), self.tracer.span("explain", kind="query",
                                                 query=query):
             stmt = parse_query(query)
+            if is_update_statement(stmt):
+                # EXPLAIN of a write: render the staged update program
+                # (and plan — not execute — its read half) without
+                # committing anything
+                up = plan_update(stmt)
+                plans = {"updates": describe_plan(up)}
+                if up.read_ast is not None:
+                    read_graph = graph.current() \
+                        if isinstance(graph, VersionedGraph) else graph
+                    ir = IRBuilder(read_graph.schema,
+                                   self._schema_resolver,
+                                   plan_params).process(up.read_ast)
+                    logical, _ctx, _planner, root, _t = self._plan_ir(
+                        read_graph, ir, plan_params, params)
+                    plans["logical"] = logical.pretty()
+                    plans["relational"] = root.pretty()
+                metrics = {"mode": "explain", "plan_s": clock.now() - t0,
+                           "rows": 0}
+                return RelationalCypherResult(plans=plans, metrics=metrics)
             ir = IRBuilder(graph.schema, self._schema_resolver,
                            plan_params).process(stmt)
             plans: Dict[str, str] = {}
@@ -556,8 +595,10 @@ class RelationalCypherSession(CypherSession):
         gtok = graph_plan_token(graph)
         if gtok is None:
             return None
-        return (normalize_query(query), gtok, self._catalog.version,
-                param_signature(params))
+        # catalog consistency is per-plan (CachedPlan.catalog_deps),
+        # not part of the key: a catalog mutation invalidates exactly
+        # its dependents instead of re-keying the whole session
+        return (normalize_query(query), gtok, param_signature(params))
 
     def _cypher_on_graph(self, graph: RelationalCypherGraph, query: str,
                          parameters: Optional[Mapping[str, Any]] = None
@@ -571,7 +612,8 @@ class RelationalCypherSession(CypherSession):
         if self.plan_cache.enabled and not no_plan_cache:
             cache_key = self._plan_cache_key(graph, query, params)
             if cache_key is not None:
-                cached = self.plan_cache.lookup(cache_key, params)
+                cached = self.plan_cache.lookup(cache_key, params,
+                                                catalog=self._catalog)
                 if cached is not None:
                     return self._run_cached(cached, query, params, t0)
 
@@ -584,20 +626,26 @@ class RelationalCypherSession(CypherSession):
             stmt = parse_query(query)
         checkpoint("parse")
 
+        if is_update_statement(stmt):
+            # the write path: read on the current snapshot, stage,
+            # commit atomically (relational/updates.py)
+            return self._run_update(graph, stmt, query, params, t0)
+
         t1 = clock.now()
-        with tracer.span("ir", kind="phase"):
-            ir = IRBuilder(graph.schema, self._schema_resolver,
-                           plan_params).process(stmt)
-        t2 = clock.now()
+        with self._record_catalog_deps() as catalog_deps:
+            with tracer.span("ir", kind="phase"):
+                ir = IRBuilder(graph.schema, self._schema_resolver,
+                               plan_params).process(stmt)
+            t2 = clock.now()
 
-        if isinstance(ir, B.CreateGraphStatement):
-            return self._run_create_graph(graph, ir, params)
-        if isinstance(ir, B.DropGraphStatement):
-            self._catalog.delete(ir.qgn)
-            return RelationalCypherResult()
+            if isinstance(ir, B.CreateGraphStatement):
+                return self._run_create_graph(graph, ir, params)
+            if isinstance(ir, B.DropGraphStatement):
+                self._catalog.delete(ir.qgn)
+                return RelationalCypherResult()
 
-        logical, context, rel_planner, root, t3 = self._plan_ir(
-            graph, ir, plan_params, params)
+            logical, context, rel_planner, root, t3 = self._plan_ir(
+                graph, ir, plan_params, params)
         checkpoint("plan")
         t4 = clock.now()
 
@@ -655,7 +703,8 @@ class RelationalCypherSession(CypherSession):
                 root=root, result_fields=logical.result_fields, plans=plans,
                 records_graph=rel_planner.current_graph, context=context,
                 spec_key=plan_params.spec_key(),
-                cold_phase_s=t4 - t0, nbytes=_plan_nbytes(plans, root))
+                cold_phase_s=t4 - t0, nbytes=_plan_nbytes(plans, root),
+                catalog_deps=tuple(sorted(catalog_deps.items())))
             # Drop the memoized results before parking the tree in the
             # cache: the records object holds the (header, table) refs,
             # so a cached plan retains no tables between executions.
@@ -727,6 +776,87 @@ class RelationalCypherSession(CypherSession):
         result.profile = result_profile
         return result
 
+    # -- update statements (relational/updates.py) ---------------------------
+
+    def _run_update(self, graph: RelationalCypherGraph,
+                    stmt, query: str, params: Dict[str, Any],
+                    t0: float) -> CypherResult:
+        """Execute a ``CREATE``/``SET``/``DELETE`` statement: plan-split
+        it into a read query + staging directives, run the read part on
+        the writer's CURRENT snapshot through the normal pipeline, stage
+        per-row update ops host-side, and commit them atomically through
+        the versioned handle.  A failure anywhere before the publish —
+        validation, device placement, an injected fault — leaves the
+        graph untouched (the commit is failure-atomic), so the serving
+        tier may retry a transiently-failed write safely."""
+        if not isinstance(graph, VersionedGraph):
+            kind = type(graph).__name__
+            if kind == "GraphSnapshot":
+                raise UpdateError(
+                    "snapshots are immutable — submit writes against "
+                    "the versioned graph handle, not a pinned snapshot")
+            raise UpdateError(
+                f"updates need a versioned graph "
+                f"(session.create_versioned_graph / "
+                f"caps_tpu.relational.updates.versioned), got {kind}")
+        tracer = self.tracer
+        from caps_tpu.frontend.semantic import check_statement
+        check_statement(stmt)  # scope errors surface before any staging
+        plan = plan_update(stmt)
+        snap = graph.current()
+        t1 = clock.now()
+        rows: List[Dict[str, Any]] = [{}]
+        if plan.read_ast is not None:
+            rows = self._execute_read_ast(snap, plan.read_ast, params)
+        checkpoint("execute")
+        t2 = clock.now()
+        staged = stage_rows(plan, rows, params)
+        with tracer.span("apply", kind="phase"):
+            info = graph.apply(staged)
+        checkpoint("execute")
+        t3 = clock.now()
+        metrics = {
+            "parse_s": t1 - t0, "read_s": t2 - t1, "apply_s": t3 - t2,
+            "rows": 0, "plan_cache": "off",
+            "updates": info.counts(),
+            "snapshot_version": info.version,
+        }
+        self.metrics_registry.observe("query.execute_s", t3 - t1)
+        plans = {"ir": describe_plan(plan)}
+        logger.debug("update %r: %s -> v%d in %.1f ms", query,
+                     info.counts(), info.version, 1e3 * (t3 - t0))
+        return RelationalCypherResult(plans=plans, metrics=metrics)
+
+    def _execute_read_ast(self, graph: RelationalCypherGraph, read_ast,
+                          params: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Plan + execute the synthesized read half of an update
+        statement on the pinned snapshot and materialize its rows (the
+        bindings and computed SET/CREATE values the staging step
+        consumes).  Uncached on purpose: the snapshot advances with
+        every commit, so a write's read half is almost never re-planned
+        against the same version."""
+        plan_params = PlanParams(params)
+        ir = IRBuilder(graph.schema, self._schema_resolver,
+                       plan_params).process(read_ast)
+        logical, _context, rel_planner, root, _t3 = self._plan_ir(
+            graph, ir, plan_params, params)
+        checkpoint("plan")
+        with self.tracer.span("execute", kind="phase", update_read=True):
+            header, table = root.result
+            records = RelationalCypherRecords(
+                self, header, table, logical.result_fields,
+                graph=rel_planner.current_graph)
+        return records.to_maps()
+
+    def create_versioned_graph(self, node_tables=(),
+                               rel_tables=()) -> VersionedGraph:
+        """A writable graph: an immutable base plus the versioned delta
+        store — ``CREATE``/``SET``/``DELETE`` and ``graph.apply(...)``
+        commit new snapshots; readers are isolated on the snapshot they
+        started with (relational/updates.py)."""
+        return VersionedGraph(self,
+                              self.create_graph(node_tables, rel_tables))
+
     # -- graph-returning statements -----------------------------------------
 
     def _run_create_graph(self, graph, ir: B.CreateGraphStatement, params):
@@ -748,7 +878,26 @@ class RelationalCypherSession(CypherSession):
             raise ValueError("query does not produce a graph")
         return result_graph
 
+    @contextlib.contextmanager
+    def _record_catalog_deps(self):
+        """Collect every catalog graph the planning phases resolve on
+        this thread — the cached plan stores (qgn, dep token) pairs and
+        lookup revalidates them (scoped invalidation)."""
+        prev = getattr(self._deps_tls, "rec", None)
+        rec: Dict[QualifiedGraphName, Tuple] = {}
+        self._deps_tls.rec = rec
+        try:
+            yield rec
+        finally:
+            self._deps_tls.rec = prev
+
+    def _note_catalog_dep(self, qgn: QualifiedGraphName) -> None:
+        rec = getattr(self._deps_tls, "rec", None)
+        if rec is not None:
+            rec[qgn] = self._catalog.dep_token(qgn)
+
     def _schema_resolver(self, qgn: QualifiedGraphName) -> Schema:
+        self._note_catalog_dep(qgn)
         src = self._catalog.source(qgn.namespace)
         s = src.schema(qgn.graph_name)
         if s is None:
@@ -756,6 +905,7 @@ class RelationalCypherSession(CypherSession):
         return s
 
     def _graph_resolver(self, qgn: QualifiedGraphName) -> RelationalCypherGraph:
+        self._note_catalog_dep(qgn)
         g = self._catalog.graph(qgn)
         if not isinstance(g, RelationalCypherGraph):
             raise TypeError(f"graph {qgn!r} is not a relational graph")

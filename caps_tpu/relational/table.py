@@ -136,6 +136,15 @@ class Table(abc.ABC):
     def union_all(self, other: "Table") -> "Table":
         """Bag union; ``other`` must have the same columns."""
 
+    def drop_in(self, col: str, values) -> "Table":
+        """Drop rows whose ``col`` value is in ``values`` — the tombstone
+        mask of the versioned-snapshot overlay (relational/updates.py).
+        Device backends keep this on-device (a padded ``isin`` mask over
+        a size-bucketed id array, so the compiled program is shared
+        across snapshots); null cells never match and are kept."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement drop_in")
+
     @abc.abstractmethod
     def distinct(self) -> "Table":
         ...

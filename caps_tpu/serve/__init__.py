@@ -23,7 +23,11 @@ The layer between many client threads and one engine session
                         canary probes, graph replication
     serve/server.py     QueryServer: worker pool (one worker per device
                         replica, or one serialized stream), serve.*
-                        metrics, containment ladder, device failover
+                        metrics, containment ladder, device failover,
+                        snapshot pinning for versioned graphs
+    serve/compaction.py background compaction of a versioned default
+                        graph (delta-store backlog folding), health in
+                        stats()["compaction"]
 
 Engine hooks this package owns: ``RelationalCypherSession.cypher_batch``
 (one batched pass over a cached plan), the deadline checkpoints in
@@ -37,8 +41,9 @@ relational layer never pulls in the whole tier.
 from caps_tpu.serve.deadline import (CancelScope, cancel_scope, checkpoint,
                                      current_scope)
 from caps_tpu.serve.errors import (Cancelled, CancellationError, CircuitOpen,
-                                   DeadlineExceeded, Overloaded, QueryFailed,
-                                   ServeError, ServerClosed, WaitTimeout)
+                                   CompactionFailed, DeadlineExceeded,
+                                   Overloaded, QueryFailed, ServeError,
+                                   ServerClosed, WaitTimeout)
 from caps_tpu.serve.failure import (FATAL, POISONED_PLAN, TRANSIENT,
                                     attribute_device, classify, device_fault,
                                     device_of)
@@ -55,6 +60,7 @@ _LAZY = {
     "BATCH": "caps_tpu.serve.request",
     "RetryPolicy": "caps_tpu.serve.retry",
     "CircuitBreaker": "caps_tpu.serve.breaker",
+    "Compactor": "caps_tpu.serve.compaction",
     "ReplicaSet": "caps_tpu.serve.devices",
     "DeviceReplica": "caps_tpu.serve.devices",
     "replicate_graph": "caps_tpu.serve.devices",
@@ -64,7 +70,8 @@ _LAZY = {
 __all__ = [
     "ServeError", "ServerClosed", "Overloaded", "CancellationError",
     "DeadlineExceeded", "Cancelled", "CircuitOpen", "QueryFailed",
-    "WaitTimeout", "CancelScope", "cancel_scope", "checkpoint",
+    "WaitTimeout", "CompactionFailed", "CancelScope", "cancel_scope",
+    "checkpoint",
     "current_scope", "classify", "TRANSIENT", "POISONED_PLAN", "FATAL",
     "device_fault", "attribute_device", "device_of",
     *sorted(_LAZY),

@@ -32,13 +32,19 @@ from caps_tpu.serve.request import Request
 def batch_key(graph: Any, query: str,
               params: Mapping[str, Any]) -> Tuple[Optional[str],
                                                   Optional[Tuple]]:
-    """(query mode, batch compatibility key).  Key None = never batch."""
+    """(query mode, batch compatibility key).  Key None = never batch.
+    Update statements report mode ``"write"``: they never coalesce (each
+    is one atomic commit with its own read half) and the server routes
+    them to the versioned handle instead of a pinned snapshot."""
     from caps_tpu.frontend.parser import normalize_query, query_mode
     from caps_tpu.relational.plan_cache import (graph_plan_token,
                                                 param_signature)
+    from caps_tpu.relational.updates import is_update_query
     mode, body = query_mode(query)
     if mode is not None:
         return mode, None
+    if is_update_query(body):
+        return "write", None
     gtok = graph_plan_token(graph)
     if gtok is None:
         return None, None

@@ -103,11 +103,18 @@ def _clone_table(factory, table):
 
 
 def supports_replication(graph) -> bool:
-    """True when :func:`replicate_graph` can re-ingest this graph (scan
-    graphs and the empty ambient graph).  Requests against anything else
-    (union/catalog graphs) are pinned to device 0, which serves them on
-    the original session."""
+    """True when :func:`replicate_graph` can re-ingest this graph: scan
+    graphs, the empty ambient graph, and versioned SNAPSHOTS over a scan
+    base (the base re-ingests once per device; the snapshot's host-level
+    delta overlay rebuilds cheaply on the replica — see
+    ``DeviceReplica.graph_for``).  Requests against anything else
+    (union/catalog graphs, and WRITES — which target the mutable
+    versioned handle) are pinned to device 0, which serves them on the
+    original session."""
     from caps_tpu.relational.graphs import EmptyGraph, ScanGraph
+    from caps_tpu.relational.updates import GraphSnapshot
+    if isinstance(graph, GraphSnapshot):
+        return isinstance(graph.base, ScanGraph)
     return graph is None or isinstance(graph, (EmptyGraph, ScanGraph))
 
 
@@ -198,9 +205,34 @@ class DeviceReplica:
         """This replica's copy of ``graph``, re-ingested on first use
         (and eagerly at server construction for the default graph).
         Replica 0 serves the ORIGINAL objects — it owns the template
-        session, so its 'copy' is the graph itself."""
+        session, so its 'copy' is the graph itself.
+
+        Versioned snapshots (relational/updates.py) replicate in two
+        parts: the immutable BASE re-ingests once per device (cached by
+        identity, shared by every snapshot of the lineage), and the
+        snapshot's host-level delta overlay rebuilds through this
+        replica's factory — a cross-device retry of a pinned read
+        therefore executes the SAME snapshot version on different
+        hardware."""
         if self.index == 0 or graph is None:
             return graph if graph is not None else self.session._ambient
+        from caps_tpu.relational.updates import GraphSnapshot
+        if isinstance(graph, GraphSnapshot):
+            # resolve the base copy FIRST (recursive call takes the
+            # lock; holding it here would deadlock)
+            base_copy = self.graph_for(graph.base)
+            key = id(graph)
+            with self._graphs_lock:
+                got = self._graphs.get(key)
+                if got is not None and got[0] is graph:
+                    self._graphs[key] = self._graphs.pop(key)
+                    return got[1]
+                with self.activate():
+                    replica_graph = graph.rebase(self.session, base_copy)
+                self._graphs[key] = (graph, replica_graph)
+                while len(self._graphs) > MAX_REPLICA_GRAPHS:
+                    self._graphs.pop(next(iter(self._graphs)))
+                return replica_graph
         key = id(graph)
         with self._graphs_lock:
             got = self._graphs.get(key)

@@ -77,6 +77,13 @@ class CircuitOpen(QueryFailed):
         super().__init__(message, attempts=(), retry_after_s=retry_after_s)
 
 
+class CompactionFailed(ServeError):
+    """The background compactor (serve/compaction.py) could not run —
+    misconfiguration (a non-versioned graph) or a fold failure surfaced
+    to a caller.  Routine fold failures are NOT raised: they roll back,
+    count ``compaction.failures``, and retry on the next tick."""
+
+
 class ReplicationUnsupported(ServeError):
     """A graph that cannot be re-ingested onto another device replica
     (only scan graphs and the empty ambient graph replicate — see

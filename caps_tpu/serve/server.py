@@ -29,6 +29,18 @@ device stream exactly as before: workers share replica 0 — the caller's
 own session — and overlap admission, timeout handling, and
 materialization while one executes.
 
+**Writes.**  Against a versioned default graph
+(relational/updates.py), reads pin the latest committed snapshot AT
+ADMISSION and finish on it — batch members, retries, degraded
+re-executions, and cross-device failovers all replay that exact
+version (no torn reads); write statements keep the mutable handle
+(mode ``"write"``: never batched, pinned to device 0), commit
+failure-atomically, and flow through the same classify/retry ladder as
+reads — a transient mid-commit fault rolled back completely, so the
+retry is safe.  ``ServerConfig.compaction_threshold_rows`` enables the
+background compactor (serve/compaction.py), surfaced in
+``stats()["compaction"]``.
+
 Serving metrics land in the session's registry under ``serve.*``
 (queue depth gauge, admitted/shed/completed/requeued counters, latency +
 queue-wait + batch-size histograms, device quarantine/reinstate
@@ -144,6 +156,12 @@ class ServerConfig:
     #: seconds a quarantined device sits out before each background
     #: half-open canary probe
     device_cooldown_s: float = 1.0
+    #: delta-store backlog (rows) that triggers background compaction of
+    #: a versioned default graph (serve/compaction.py); None disables
+    #: the compactor (explicit ``graph.compact()`` still works)
+    compaction_threshold_rows: Optional[int] = None
+    #: cadence of the compactor's backlog checks
+    compaction_interval_s: float = 0.05
 
 
 class QueryServer:
@@ -207,6 +225,18 @@ class QueryServer:
         self._inflight: set = set()
         self._inflight_lock = make_lock("server.QueryServer"
                                         "._inflight_lock")
+        #: background compaction of a versioned default graph
+        #: (serve/compaction.py) — None unless configured AND the graph
+        #: is versioned
+        self.compactor = None
+        if (self.config.compaction_threshold_rows is not None
+                and getattr(self._default_graph, "graph_is_versioned",
+                            False)):
+            from caps_tpu.serve.compaction import Compactor
+            self.compactor = Compactor(
+                self._default_graph, registry,
+                threshold_rows=self.config.compaction_threshold_rows,
+                interval_s=self.config.compaction_interval_s)
         if start:
             self.start()
 
@@ -232,6 +262,8 @@ class QueryServer:
                 name=f"caps-tpu-serve-{i}-dev{replica.index}", daemon=True)
             self._threads.append(t)
             t.start()
+        if self.compactor is not None:
+            self.compactor.start()
         return self
 
     def shutdown(self, drain: bool = True,
@@ -267,6 +299,8 @@ class QueryServer:
                    else max(0.0, deadline - clock.now()))
         still_running = [t for t in self._threads if t.is_alive()]
         self._threads = still_running
+        if self.compactor is not None:
+            self.compactor.stop()
         return not still_running
 
     def __enter__(self) -> "QueryServer":
@@ -296,8 +330,22 @@ class QueryServer:
         graph = graph if graph is not None else self._default_graph
         params = dict(parameters or {})
         scope = CancelScope(budget_s=deadline_s)
+        if getattr(graph, "graph_is_versioned", False):
+            # snapshot isolation at ADMISSION: a read pins the latest
+            # committed snapshot here and finishes on it — coalesced
+            # batch members, retries, degraded re-executions, and
+            # cross-device failovers all replay against this exact
+            # version, whatever writes commit meanwhile.  Writes keep
+            # the handle (they serialize on its commit lock and always
+            # see the latest state).  Resolve BEFORE keying so the
+            # admission path computes the batch key exactly once.
+            from caps_tpu.relational.updates import is_update_query
+            if not is_update_query(query):
+                graph = graph.current()
         mode, key = _batcher.batch_key(graph, query, params)
         req = Request(query, params, graph, priority, scope, key, mode)
+        if getattr(graph, "snapshot_version", None) is not None:
+            req.handle.info["snapshot_version"] = graph.snapshot_version
         self.admission.offer(req)  # may raise ServerClosed / Overloaded
         return req.handle
 
@@ -319,6 +367,8 @@ class QueryServer:
         out["health"] = self.health()
         out["breakers"] = self.breaker.summary()
         out["devices"] = self.devices.summary()
+        out["compaction"] = (self.compactor.summary()
+                             if self.compactor is not None else None)
         return out
 
     def health(self) -> str:
@@ -331,6 +381,10 @@ class QueryServer:
         if self.admission.closed:
             return "lame-duck"
         if self.breaker.open_count() or self.devices.quarantined_count():
+            return "degraded"
+        if self.compactor is not None and self.compactor.failing:
+            # serving still works, but the delta overlay has stopped
+            # shrinking — capacity planning must see it
             return "degraded"
         return "healthy"
 

@@ -17,12 +17,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 from caps_tpu.frontend import ast
 from caps_tpu.frontend.parser import parse_query
 from caps_tpu.ir import exprs as E
-from caps_tpu.okapi.types import (
-    CTInteger, CypherType, from_python, join_all,
-)
-from caps_tpu.relational.entity_tables import (
-    NodeMapping, NodeTable, RelationshipMapping, RelationshipTable,
-)
+from caps_tpu.relational.entity_tables import NodeTable, RelationshipTable
 from caps_tpu.relational.graphs import ScanGraph
 
 
@@ -137,49 +132,18 @@ def parse_create(create_query: str,
 
 def tables_from_memory(session, g: InMemoryTestGraph
                        ) -> Tuple[List[NodeTable], List[RelationshipTable]]:
+    """Group in-memory records into scan tables.  Delegates to the
+    shared record-grouping builders in relational/updates.py — the SAME
+    code that materializes delta stores and compacted bases, so the
+    factory, the write path, and compaction agree on layout by
+    construction."""
+    from caps_tpu.relational.updates import (build_node_tables,
+                                             build_rel_tables)
     factory = session.table_factory
-
-    by_labels: Dict[Tuple[str, ...], List[Tuple[int, Dict[str, Any]]]] = {}
-    for nid, (labels, props) in g.nodes.items():
-        by_labels.setdefault(labels, []).append((nid, props))
-    node_tables = []
-    for labels, rows in sorted(by_labels.items()):
-        keys = sorted({k for _, p in rows for k in p})
-        types: Dict[str, CypherType] = {"_id": CTInteger}
-        data: Dict[str, List[Any]] = {"_id": [nid for nid, _ in rows]}
-        for k in keys:
-            vals = [p.get(k) for _, p in rows]
-            t = join_all(from_python(v) for v in vals if v is not None)
-            if any(v is None for v in vals):
-                t = t.nullable
-            types[k] = t
-            data[k] = vals
-        mapping = NodeMapping.on("_id").with_implied_labels(*labels)
-        for k in keys:
-            mapping = mapping.with_property(k)
-        node_tables.append(NodeTable(mapping, factory.from_columns(data, types)))
-
-    by_type: Dict[str, List[Tuple[int, int, int, Dict[str, Any]]]] = {}
-    for rid, src, tgt, rel_type, props in g.rels:
-        by_type.setdefault(rel_type, []).append((rid, src, tgt, props))
-    rel_tables = []
-    for rel_type, rows in sorted(by_type.items()):
-        keys = sorted({k for *_, p in rows for k in p})
-        types = {"_id": CTInteger, "_src": CTInteger, "_tgt": CTInteger}
-        data = {"_id": [r[0] for r in rows], "_src": [r[1] for r in rows],
-                "_tgt": [r[2] for r in rows]}
-        for k in keys:
-            vals = [r[3].get(k) for r in rows]
-            t = join_all(from_python(v) for v in vals if v is not None)
-            if any(v is None for v in vals):
-                t = t.nullable
-            types[k] = t
-            data[k] = vals
-        mapping = RelationshipMapping.on(rel_type)
-        for k in keys:
-            mapping = mapping.with_property(k)
-        rel_tables.append(RelationshipTable(mapping,
-                                            factory.from_columns(data, types)))
+    node_tables = build_node_tables(
+        factory, [(nid, labels, props)
+                  for nid, (labels, props) in g.nodes.items()])
+    rel_tables = build_rel_tables(factory, g.rels)
     return node_tables, rel_tables
 
 

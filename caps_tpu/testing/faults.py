@@ -23,6 +23,12 @@ This module provides them:
   streams never see them;
 * :func:`flaky_ingest` — fail the first N table ingests of a session
   with a transient device error;
+* :func:`abort_write` — abort a versioned-graph commit after N delta
+  columns placed (the failure-atomicity probe: the commit must roll
+  back completely and a retried write must succeed);
+* :func:`flaky_compaction` — fail a deterministic fraction of
+  compaction folds, scoped to the compaction thread only (serving
+  and writes never see it);
 * :func:`corrupt_shard` — silent data damage on one shard (digest /
   parity detection tests);
 * :class:`FaultPlan` — compose any of the above into one context
@@ -361,6 +367,90 @@ def device_oom(phase: str = "execute", op_name: str = "Scan",
     if session is None:
         raise ValueError("device_oom(phase='ingest') needs session=")
     with flaky_ingest(session, n_times=n_times, exc=make_oom) as budget:
+        yield budget
+
+
+def _make_write_abort() -> BaseException:
+    """A fresh ``ABORTED`` in device-runtime shape: serve/failure.py
+    classifies it TRANSIENT, so the server retries the write — which is
+    SAFE precisely because the commit it interrupted rolled back
+    completely (the atomicity the abort_write tests assert)."""
+    cls = xla_runtime_error_class()
+    return cls("ABORTED: transfer interrupted mid-commit "
+               "[injected write abort]")
+
+
+@contextlib.contextmanager
+def abort_write(session, after_n_columns: int = 1,
+                n_times: Optional[int] = 1, every_n: int = 1):
+    """Abort a versioned-graph commit MID-APPLY: the first
+    ``after_n_columns`` device column placements of each injection
+    window succeed, then the next placement raises a fresh transient
+    ``ABORTED`` device error — exactly the torn-write shape the
+    failure-atomic commit (relational/updates.py) must roll back
+    (delta tables dropped, string pool rolled back to the pre-commit
+    mark, snapshot unchanged).
+
+    ``n_times`` bounds total injections (None = permanent),
+    ``every_n`` spaces them out over eligible placements — the soak
+    acceptance's "~20% of writes abort once, every retry heals" shape.
+    Compaction folds are NOT targeted (use :func:`flaky_compaction`).
+    Yields the injection budget (``.injected``)."""
+    backend = getattr(session, "backend", None)
+    if backend is None or not hasattr(backend, "place_column"):
+        raise ValueError("abort_write needs a device-backed session")
+    budget = _Budget(n_times, every_n)
+    survived = {"n": 0}
+    state_lock = make_lock("faults.abort_write.state_lock")
+
+    def wrap(orig):
+        def poisoned(col):
+            from caps_tpu.relational.updates import in_compaction
+            if in_compaction():
+                return orig(col)
+            with state_lock:
+                survived["n"] += 1
+                fire = survived["n"] > after_n_columns
+                if fire:
+                    survived["n"] = 0  # next window builds afresh
+            if fire and budget.take():
+                _count_injection("abort_write")
+                raise _make_write_abort()
+            return orig(col)
+        return poisoned
+
+    with _patched_place_column(backend, wrap):
+        yield budget
+
+
+@contextlib.contextmanager
+def flaky_compaction(session, error_rate: float = 0.5,
+                     n_times: Optional[int] = None):
+    """Fail a deterministic ~``error_rate`` fraction of COMPACTION
+    column placements with a transient device error — scoped by the
+    compaction thread-local (relational/updates.py ``in_compaction``),
+    so concurrent writes and reads never see it.  The obligations under
+    this fault: the fold rolls back (pool restored, snapshot
+    unchanged), ``compaction.failures``/``faults.injected.*`` count it,
+    serving continues, and the next fold attempt succeeds once the
+    budget is spent.  Yields the injection budget."""
+    if not 0.0 < error_rate <= 1.0:
+        raise ValueError(f"error_rate must be in (0, 1], got {error_rate}")
+    backend = getattr(session, "backend", None)
+    if backend is None or not hasattr(backend, "place_column"):
+        raise ValueError("flaky_compaction needs a device-backed session")
+    budget = _Budget(n_times, every_n=max(1, int(round(1.0 / error_rate))))
+
+    def wrap(orig):
+        def poisoned(col):
+            from caps_tpu.relational.updates import in_compaction
+            if in_compaction() and budget.take():
+                _count_injection("flaky_compaction")
+                raise _make_write_abort()
+            return orig(col)
+        return poisoned
+
+    with _patched_place_column(backend, wrap):
         yield budget
 
 

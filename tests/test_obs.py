@@ -251,11 +251,14 @@ def test_session_metrics_snapshot_absorbs_scattered_stats(make_session):
 def test_plan_cache_invalidations_in_snapshot(make_session):
     session = make_session("local")
     graph = create_graph(session, CREATE)
-    graph.cypher(Q, {"min": 25})
+    session.catalog.store("obs_snap", graph)
+    # this plan DEPENDS on the catalog name; a graph-object plan would
+    # survive catalog churn (scoped eviction)
+    session.cypher("FROM GRAPH session.obs_snap MATCH (n:Person) "
+                   "RETURN count(*) AS c")
     snap0 = session.metrics_snapshot()
-    # catalog mutation bumps the fingerprint and evicts dependents
-    session.cypher("CATALOG CREATE GRAPH session.obs_snap { "
-                   "MATCH (n:Person) CONSTRUCT NEW () RETURN GRAPH }")
+    # mutating the referenced name evicts exactly its dependents
+    session.catalog.store("obs_snap", create_graph(session, CREATE))
     delta = diff_snapshots(snap0, session.metrics_snapshot())
     assert delta["plan_cache.invalidations"] >= 1
 

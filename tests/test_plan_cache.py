@@ -173,7 +173,11 @@ def test_catalog_create_drop_invalidates():
         session.cypher(q)
 
 
-def test_catalog_create_graph_statement_invalidates():
+def test_catalog_mutation_eviction_is_scoped():
+    """Catalog eviction is scoped per graph name: storing an UNRELATED
+    graph leaves another name's dependents cached (the old behavior
+    evicted everything on any mutation), while mutating the referenced
+    name still invalidates its dependents."""
     session = _session()
     base = create_graph(session, "CREATE (:Person {name: 'A'})")
     session.catalog.store("base", base)
@@ -181,11 +185,21 @@ def test_catalog_create_graph_statement_invalidates():
     assert _rows(session.cypher(q)) == [{"c": 1}]
     entries_before = session.plan_cache.stats()["entries"]
     assert entries_before >= 1
+    # an unrelated catalog mutation: session.base dependents SURVIVE
     session.cypher("CATALOG CREATE GRAPH copy { "
                    "FROM GRAPH session.base RETURN GRAPH }")
-    # the CREATE bumped the catalog fingerprint: dependents evicted
-    assert session.plan_cache.stats()["entries"] == 0
-    assert _rows(session.cypher(q)) == [{"c": 1}]
+    assert session.plan_cache.stats()["entries"] == entries_before
+    res = session.cypher(q)
+    assert res.metrics["plan_cache"] == "hit"
+    assert _rows(res) == [{"c": 1}]
+    # mutating the REFERENCED name still evicts its dependents
+    inv_before = session.plan_cache.stats()["invalidations"]
+    session.catalog.store("base", create_graph(
+        session, "CREATE (:Person {name: 'A'}), (:Person {name: 'B'})"))
+    assert session.plan_cache.stats()["invalidations"] > inv_before
+    res = session.cypher(q)
+    assert res.metrics["plan_cache"] == "miss"
+    assert _rows(res) == [{"c": 2}]
 
 
 # -- LRU -------------------------------------------------------------------

@@ -547,14 +547,20 @@ def test_catalog_mutation_concurrent_with_subscription_eviction(
     for t in threads:
         t.join()
     assert not errors, errors
-    # Stale-fingerprint entries are UNREACHABLE (the lookup key embeds
-    # the current version) — a query that straddled a mutation may have
-    # parked one after the eager eviction ran; the next bump collects
-    # it.  Assert exactly that: one more eviction pass leaves only
-    # entries planned under the live fingerprint.
+    # Scoped eviction: the querier's plan has NO catalog dependencies
+    # (it runs on the graph object, not FROM GRAPH), so 400 interleaved
+    # catalog mutations must not have evicted it — and any entry that
+    # DOES carry catalog deps validates against the live catalog
+    # (stale-dep entries are dropped at lookup, never served).
     cache = session.plan_cache
-    cache.evict_stale(session.catalog.version)
-    assert all(k[2] == session.catalog.version for k in cache._entries)
+    with cache._lock:
+        plans = [p for ps in cache._entries.values() for p in ps]
+    assert plans, "the hot query's plan should still be cached"
+    for p in plans:
+        for qgn, tok in p.catalog_deps:
+            assert session.catalog.dep_token(qgn) == tok
     # and the cached plan still serves correct results afterwards
-    assert [r["n"] for r in graph.cypher(q, {"min": 20}).records.to_maps()
+    res = graph.cypher(q, {"min": 20})
+    assert res.metrics["plan_cache"] == "hit"
+    assert [r["n"] for r in res.records.to_maps()
             ] == ["Alice", "Bob", "Carol", "Dana"]
