@@ -429,10 +429,12 @@ def run_serve_config(on_tpu: bool):
     vs_baseline = served throughput over single-threaded sequential
     ``PreparedQuery.run`` on the same session (the pre-serving path).
     """
+    import re as _re
     import threading as _th
     import numpy as np
     from caps_tpu.backends.tpu.session import TPUCypherSession
     from caps_tpu.obs import diff_snapshots
+    from caps_tpu.obs.telemetry import SLOConfig
     from caps_tpu.serve import Overloaded, QueryServer, ServerConfig
 
     _result.update({"metric": "serve QPS (no measurement completed)",
@@ -478,7 +480,9 @@ def run_serve_config(on_tpu: bool):
     clients = int(sys.argv[2]) if len(sys.argv) > 2 else 8
     per_client = int(os.environ.get("BENCH_SERVE_REQS", "40"))
     server = QueryServer(session, graph=graph, config=ServerConfig(
-        workers=2, max_queue=256, max_batch=16, batch_window_s=0.001))
+        workers=2, max_queue=256, max_batch=16, batch_window_s=0.001,
+        slo=SLOConfig(latency_target_s=1.0, latency_objective=0.95,
+                      availability_objective=0.99)))
     latencies, errors = [], []
 
     def client(i):
@@ -516,6 +520,25 @@ def run_serve_config(on_tpu: bool):
             / max(1, closed.get("serve.batch_size.count", 1)), 3),
         "closed_loop_batch_max": closed.get("serve.batch_size.max", 0),
         **_percentiles(latencies),
+    })
+    # windowed telemetry + SLO burn rate, SERVER-side (obs/telemetry.py)
+    # — not recomputed from the client-side latency list above
+    report = server.health_report()
+    win, slo = report["window"], report["slo"]
+    _result.update({
+        "telemetry_window_s": win["window_s"],
+        "telemetry_qps": win["qps"],
+        "telemetry_p50_s": win["latency"]["p50_s"],
+        "telemetry_p95_s": win["latency"]["p95_s"],
+        "telemetry_p99_s": win["latency"]["p99_s"],
+        "telemetry_queue_wait_p95_s": win["queue_wait"]["p95_s"],
+        "telemetry_batch_occupancy": round(win["batch_occupancy"], 3),
+        "slo_latency_compliance": slo["latency_compliance"],
+        "slo_latency_burn_rate": slo["latency_burn_rate"],
+        "slo_availability": slo["availability"],
+        "slo_availability_burn_rate": slo["availability_burn_rate"],
+        "slo_within_budget": slo["within_budget"],
+        "batching": server.stats()["batching"],
     })
 
     # -- open loop: Poisson arrivals over capacity ---------------------
@@ -561,6 +584,67 @@ def run_serve_config(on_tpu: bool):
                 / max(1, open_delta.get("serve.batch_size.count", 1)), 3),
             "open_loop_completed": open_delta.get("serve.completed", 0),
         })
+
+    # -- flight recorder: 8-client soak with an injected breaker trip --
+    if _remaining() > 12:
+        from caps_tpu.testing.faults import failing_operator
+        poison_q = ("MATCH (p:Person) WHERE p.age > $min "
+                    "RETURN p.name AS n ORDER BY n LIMIT 3")
+
+        def soak_client(i):
+            for j in range(6):
+                try:
+                    if (i + j) % 2:
+                        server.run(poison_q, {"min": j})
+                    else:
+                        server.run(PARAM_QUERY,
+                                   {"seed": seeds[j % len(seeds)]})
+                except Exception:
+                    pass  # failures are the point of this phase
+
+        with failing_operator("OrderBy", exc=RuntimeError("bench poison"),
+                              n_times=None):
+            soakers = [_th.Thread(target=soak_client, args=(i,))
+                       for i in range(8)]
+            for t in soakers:
+                t.start()
+            for t in soakers:
+                t.join()
+        dumps = server.telemetry.flight_dumps
+        failing_recs = [r for d in dumps for r in d["records"]
+                        if r.get("attempts")]
+        _result.update({
+            "flight_dumps": len(dumps),
+            "flight_dump_reasons": sorted({d["reason"] for d in dumps}),
+            "flight_records_with_attempts": len(failing_recs),
+            "flight_attempt_modes": sorted({a["mode"]
+                                            for r in failing_recs
+                                            for a in r["attempts"]}),
+        })
+
+    # -- observed-statistics store + Prometheus exposition -------------
+    ops_summary = session.op_stats.summary()
+    families = session.op_stats.stats()
+    _result.update({
+        "opstats_families": ops_summary["families"],
+        "opstats_operators": ops_summary["operators"],
+        "opstats_divergences": ops_summary["divergences"],
+        # every executed plan family holds per-operator actual rows
+        "opstats_all_families_have_rows": all(
+            ops and all(st["executions"] >= 1 for st in ops.values())
+            for ops in families.values()),
+    })
+    text = server.metrics_text()
+    sample_re = _re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+="[^"]*"\})? '
+        r'[0-9eE.+\-]+$')
+    samples = 0
+    for line in text.splitlines():
+        if not line or line.startswith("# TYPE "):
+            continue
+        assert sample_re.match(line), f"unparseable exposition: {line!r}"
+        samples += 1
+    _result["expose_text_samples"] = samples
     server.shutdown()
     _emit()
 

@@ -98,7 +98,7 @@ class AnalysisConfig:
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
         "faults", "fused", "dist_join", "obs", "backend", "tracer",
-        "updates", "compaction"})
+        "updates", "compaction", "telemetry", "slo", "opstats"})
     #: extra tracer-purity roots: every method with one of these names in
     #: the listed dirs is treated as reached by the fused record path
     #: (operator ``_compute`` bodies are recorded and replayed — clock

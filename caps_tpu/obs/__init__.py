@@ -26,6 +26,9 @@ from caps_tpu.obs.metrics import (MetricsRegistry, diff_snapshots,
                                   global_registry)
 from caps_tpu.obs.profile import (find_executed_rows, profile_tree,
                                   render_profile, tag_timing)
+from caps_tpu.obs.telemetry import (FlightRecorder, OpStatsStore,
+                                    RollingCounter, RollingHistogram,
+                                    ServingTelemetry, SLOConfig)
 from caps_tpu.obs.tracer import (NULL_SPAN, NullSpan, Span, Tracer, activate,
                                  active_tracer)
 
@@ -35,4 +38,6 @@ __all__ = [
     "active_tracer", "MetricsRegistry", "global_registry", "diff_snapshots",
     "write_jsonl", "write_chrome_trace", "chrome_trace_events",
     "profile_tree", "render_profile", "tag_timing", "find_executed_rows",
+    "SLOConfig", "ServingTelemetry", "FlightRecorder", "OpStatsStore",
+    "RollingCounter", "RollingHistogram",
 ]

@@ -56,8 +56,15 @@ def write_jsonl(spans: Iterable[Span], path_or_file: PathOrFile) -> int:
 
 
 def chrome_trace_events(spans: Iterable[Span]) -> List[Dict[str, Any]]:
-    """Spans → Trace Event Format dicts (ts/dur in microseconds)."""
+    """Spans → Trace Event Format dicts (ts/dur in microseconds).
+
+    ``pid`` is the span's device/replica index (the ``device`` attr the
+    tracer stamps inside a replica's execution bracket — serve/devices.py
+    installs the provider), inherited from the parent span when a child
+    lacks its own and falling back to 0: multi-replica traces render as
+    parallel per-device lanes instead of interleaving on one row."""
     events: List[Dict[str, Any]] = []
+    lane: Dict[int, int] = {}
     for span, sid, parent in _walk(spans):
         args: Dict[str, Any] = dict(span.attrs)
         if span.rows is not None:
@@ -66,7 +73,12 @@ def chrome_trace_events(spans: Iterable[Span]) -> List[Dict[str, Any]]:
             args["bytes"] = span.bytes
         if span.device_s is not None:
             args["device_ms"] = round(1e3 * span.device_s, 6)
-        base = {"name": span.name, "cat": span.kind, "pid": 0, "tid": 0,
+        try:
+            pid = int(span.attrs["device"])
+        except (KeyError, TypeError, ValueError):
+            pid = lane.get(parent, 0)
+        lane[sid] = pid
+        base = {"name": span.name, "cat": span.kind, "pid": pid, "tid": 0,
                 "ts": round(1e6 * span.t0, 3), "args": args}
         if span.kind == "event" or (span.wall_s == 0.0 and not span.children):
             events.append({**base, "ph": "i", "s": "t"})

@@ -24,11 +24,34 @@ plus a registry lock for get-or-create): the serving tier
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Sequence, Union
+import re
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Union
 
 from caps_tpu.obs.lockgraph import make_lock
 
 Number = Union[int, float]
+
+_EXPO_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _expo_name(name: str) -> str:
+    """A dotted registry name as a Prometheus metric name: the exposition
+    grammar allows ``[a-zA-Z_:][a-zA-Z0-9_:]*``, so dots (and anything
+    else) become underscores and a leading digit gets prefixed."""
+    n = _EXPO_BAD.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n or "_"
+
+
+def _expo_num(v: Number) -> str:
+    """A sample value in exposition syntax (Go-style float parsing on the
+    scrape side accepts plain ints, decimals, and scientific notation)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
 
 
 class Counter:
@@ -124,6 +147,12 @@ class Histogram:
                 out["mean"] = self.sum / self.count
             return out
 
+    def raw(self):
+        """``(bounds, per-bucket counts copy, count, sum)`` read under
+        the lock — the Prometheus exposition path's consistent view."""
+        with self._lock:
+            return self.buckets, list(self.counts), self.count, self.sum
+
 
 class MetricsRegistry:
     """Name → instrument map with get-or-create accessors.
@@ -191,6 +220,51 @@ class MetricsRegistry:
             for k, v in h.snapshot().items():
                 out[f"{name}.{k}"] = v
         return out
+
+    def expose_text(self, extra: Optional[Mapping[str, Number]] = None
+                    ) -> str:
+        """The whole registry in Prometheus text exposition format
+        (version 0.0.4): counters and gauges as single samples,
+        histograms as cumulative ``_bucket{le=...}`` series plus
+        ``_sum``/``_count``.  Dotted names sanitize to underscore form
+        (``serve.completed`` → ``serve_completed``).  ``extra`` renders
+        additional ``{name: value}`` pairs as gauges — the serving
+        tier's windowed values ride this when they are not already
+        registered as live-callback gauges."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            histograms = sorted(self._histograms.items())
+        lines = []
+        for name, c in counters:
+            n = _expo_name(name)
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {_expo_num(c.value)}")
+        for name, g in gauges:
+            n = _expo_name(name)
+            v = g.value
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue  # a callback gauge may surface non-numerics
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_expo_num(v)}")
+        for name, h in histograms:
+            n = _expo_name(name)
+            bounds, counts, count, total = h.raw()
+            lines.append(f"# TYPE {n} histogram")
+            cum = 0
+            for le, cnt in zip(bounds, counts):
+                cum += cnt
+                lines.append(f'{n}_bucket{{le="{_expo_num(le)}"}} {cum}')
+            lines.append(f'{n}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{n}_sum {_expo_num(total)}")
+            lines.append(f"{n}_count {count}")
+        for name, v in sorted((extra or {}).items()):
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            n = _expo_name(name)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_expo_num(v)}")
+        return "\n".join(lines) + "\n"
 
     def clear(self) -> None:
         with self._lock:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import itertools
 from contextlib import nullcontext
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -87,6 +88,12 @@ class RelationalRuntimeContext:
         # the session tracer, cached so the per-operator hot path pays
         # one attribute read (None for bare/mock sessions in tests)
         self.tracer = getattr(session, "tracer", None)
+        # plan-node id sequence: operators draw a stable id at
+        # CONSTRUCTION (planner order is deterministic per query), so
+        # the observed-statistics store (obs/telemetry.py OpStatsStore)
+        # can key measurements by (plan family, operator id) across
+        # executions, replans, and fused replays
+        self.op_seq = itertools.count()
 
     def rebind(self, parameters: Mapping[str, Any]) -> None:
         """Swap in fresh parameter bindings for a cached-plan
@@ -177,6 +184,10 @@ class RelationalOperator(abc.ABC):
         # op's planning time (multi-graph correctness — see EntityContext)
         self.entity_ctx: Optional[EntityContext] = getattr(
             context, "entity_ctx", None)
+        # stable per-plan node id (observed-statistics key; -1 under bare
+        # mock contexts in unit tests)
+        seq = getattr(context, "op_seq", None)
+        self.op_id: int = next(seq) if seq is not None else -1
 
     @property
     def parameters(self) -> Dict[str, Any]:
@@ -259,6 +270,7 @@ class RelationalOperator(abc.ABC):
                 rows = self._result[1].size
             entry = {
                 "op": name,
+                "op_id": self.op_id,
                 "seconds": clock.now() - t0,
                 "rows": rows,
                 "bytes_in": bytes_in,

@@ -320,6 +320,12 @@ class RelationalCypherSession(CypherSession):
         # PROFILE query force-enables it.
         self.metrics_registry = obs.MetricsRegistry()
         self.tracer = obs.Tracer(enabled=self.config.trace)
+        # Observed per-operator statistics (obs/telemetry.py): every
+        # execution folds its op_metrics entries in, keyed by
+        # (plan family, operator id) — the substrate the cost-based
+        # planner (ROADMAP item 4) reads.  Fused-replay aware for free:
+        # the entries recorded are the same ones PROFILE annotates.
+        self.op_stats = obs.OpStatsStore(registry=self.metrics_registry)
         self._profiling = False
         # Prepared-statement plan cache (relational/plan_cache.py): keyed
         # value-independently; catalog mutations evict dependent entries.
@@ -615,7 +621,8 @@ class RelationalCypherSession(CypherSession):
                 cached = self.plan_cache.lookup(cache_key, params,
                                                 catalog=self._catalog)
                 if cached is not None:
-                    return self._run_cached(cached, query, params, t0)
+                    return self._run_cached(cached, query, params, t0,
+                                            family=cache_key[0])
 
         # Cold path: the full frontend.  Planning sees the parameters
         # through a PlanParams view, which records any plan-time VALUE
@@ -690,6 +697,12 @@ class RelationalCypherSession(CypherSession):
                      metrics["rows"], 1e3 * (t5 - t0))
         self.metrics_registry.observe("query.plan_s", t4 - t0)
         self.metrics_registry.observe("query.execute_s", t5 - t4)
+        # observed-statistics fold: the plan family is the cache key's
+        # normalized query text (computed lazily when the cache was
+        # bypassed — uncacheable graph, degraded run, cache off)
+        self.op_stats.record(
+            cache_key[0] if cache_key is not None else
+            normalize_query(query), context.op_metrics)
         if self._profiling:
             # snapshot per-operator measurements into plain dicts BEFORE
             # the cache store resets the tree (obs/profile.py)
@@ -715,7 +728,8 @@ class RelationalCypherSession(CypherSession):
         return result
 
     def _run_cached(self, plan: CachedPlan, query: str,
-                    params: Dict[str, Any], t0: float) -> CypherResult:
+                    params: Dict[str, Any], t0: float,
+                    family: Optional[str] = None) -> CypherResult:
         """Execute a cached relational operator tree with fresh parameter
         bindings: swap the shared runtime context's parameters, clear the
         per-run memos, and pull the root's result.  parse/ir/plan/
@@ -772,6 +786,13 @@ class RelationalCypherSession(CypherSession):
         logger.debug("query %r: %d rows in %.1f ms (plan cache hit)",
                      query, metrics["rows"], 1e3 * (t2 - t0))
         self.metrics_registry.observe("query.execute_s", t2 - t1)
+        # observed statistics: op_metrics was captured before the exec
+        # lock released (rebind swaps in a FRESH list per run, so this
+        # reference stays consistent even if another thread re-executes
+        # the same cached plan meanwhile)
+        self.op_stats.record(
+            family if family is not None else normalize_query(query),
+            op_metrics)
         result = RelationalCypherResult(records, None, plan.plans, metrics)
         result.profile = result_profile
         return result

@@ -60,6 +60,9 @@ _LAZY = {
     "BATCH": "caps_tpu.serve.request",
     "RetryPolicy": "caps_tpu.serve.retry",
     "CircuitBreaker": "caps_tpu.serve.breaker",
+    # re-exported from obs/telemetry.py: the serving SLO config rides
+    # ServerConfig, so clients naturally look for it here
+    "SLOConfig": "caps_tpu.obs.telemetry",
     "Compactor": "caps_tpu.serve.compaction",
     "ReplicaSet": "caps_tpu.serve.devices",
     "DeviceReplica": "caps_tpu.serve.devices",

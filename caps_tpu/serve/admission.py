@@ -2,8 +2,9 @@
 
 The server never queues unboundedly.  ``offer`` either admits a request
 or sheds it with a typed :class:`~caps_tpu.serve.errors.Overloaded`
-carrying a ``retry_after_s`` hint (queue depth x the server's moving
-per-request service time / worker count).  Two bounds apply:
+carrying a ``retry_after_s`` hint (queue depth x recent per-request
+service time / worker count — the telemetry window's mean when it has
+samples, the running EMA as fallback).  Two bounds apply:
 
 * a global capacity (``max_queue``) across all priorities;
 * optional per-priority limits, so background/batch traffic cannot
@@ -65,7 +66,7 @@ def _deregister_depth_gauge(registry: MetricsRegistry,
 class AdmissionController:
     def __init__(self, registry: MetricsRegistry, max_queue: int = 64,
                  per_priority_limits: Optional[Dict[int, int]] = None,
-                 workers: int = 1):
+                 workers: int = 1, telemetry=None):
         self.max_queue = max(1, int(max_queue))
         self.per_priority_limits = dict(per_priority_limits or {})
         self.workers = max(1, int(workers))
@@ -74,8 +75,14 @@ class AdmissionController:
         self._depth = 0
         self._closed = False
         #: EMA of per-request service seconds, updated by the server
-        #: after each batch — the retry_after estimator's rate term.
+        #: after each batch — the retry_after estimator's FALLBACK rate
+        #: term (see retry_after_s).
         self.ema_service_s = 0.0
+        #: optional windowed-telemetry handle (obs/telemetry.py
+        #: ServingTelemetry): sheds are noted into the rolling window,
+        #: and retry_after's rate term prefers the window's recent mean
+        #: service time over the forever-EMA.
+        self._telemetry = telemetry
         self._admitted = registry.counter("serve.admitted")
         self._shed = registry.counter("serve.shed")
         self._requeued = registry.counter("serve.requeued")
@@ -92,8 +99,19 @@ class AdmissionController:
             return len(q) if q else 0
 
     def retry_after_s(self, depth: Optional[int] = None) -> float:
+        """Back-off hint: queue depth × per-request service time /
+        parallel streams.  The rate term prefers the telemetry window's
+        recent mean service time; the forever-EMA is only the fallback
+        for windows with no samples (cold start, long idle) — a one-off
+        slow burst therefore stops inflating shed hints as soon as it
+        rotates out of the window, instead of lingering in the EMA."""
         d = self._depth if depth is None else depth
-        return max(_MIN_RETRY_S, d * self.ema_service_s / self.workers)
+        rate = self.ema_service_s
+        if self._telemetry is not None:
+            recent = self._telemetry.recent_service_s()
+            if recent is not None:
+                rate = recent
+        return max(_MIN_RETRY_S, d * rate / self.workers)
 
     def observe_service(self, per_request_s: float) -> None:
         """Fold one batch's per-request service time into the EMA
@@ -142,6 +160,8 @@ class AdmissionController:
             if self._depth >= self.max_queue or \
                     (limit is not None and prio_depth >= limit):
                 self._shed.inc()
+                if self._telemetry is not None:
+                    self._telemetry.note_shed()
                 raise Overloaded(
                     f"queue full (depth {self._depth}/{self.max_queue}, "
                     f"priority {prio}: {prio_depth}"

@@ -41,7 +41,7 @@ class Compactor:
     """Threshold-driven background compaction of one versioned graph."""
 
     def __init__(self, graph, registry, threshold_rows: int = 512,
-                 interval_s: float = 0.05):
+                 interval_s: float = 0.05, on_failure=None):
         if not getattr(graph, "graph_is_versioned", False):
             raise CompactionFailed(
                 f"compaction needs a versioned graph, got "
@@ -49,6 +49,10 @@ class Compactor:
         self.graph = graph
         self.threshold_rows = max(1, int(threshold_rows))
         self.interval_s = float(interval_s)
+        #: optional incident hook called with the exception after every
+        #: failed fold — the server wires the telemetry flight-recorder
+        #: auto-dump here (a dying compactor is a postmortem trigger)
+        self._on_failure = on_failure
         self._failures = registry.counter("compaction.failures")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -89,6 +93,11 @@ class Compactor:
                     self._consecutive_failures += 1
                     self._last_error = f"{type(ex).__name__}: {ex}"
                     self._state = FAILING
+                    if self._on_failure is not None:
+                        try:
+                            self._on_failure(ex)
+                        except Exception:  # pragma: no cover — hook only
+                            pass
                 else:
                     self._consecutive_failures = 0
                     self._last_error = None

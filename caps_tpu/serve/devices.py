@@ -79,6 +79,15 @@ def executing_device_index() -> Optional[int]:
     return getattr(_exec_tls, "device_index", None)
 
 
+# chrome-trace device lanes: spans opened inside a replica's execution
+# bracket carry the device index, and obs/export.py renders it as the
+# trace event's pid — multi-replica traces lay out as parallel lanes.
+# The provider hook lives in obs/tracer.py (obs/ never imports serve/).
+from caps_tpu.obs import tracer as _tracer_mod  # noqa: E402
+
+_tracer_mod.set_device_index_provider(executing_device_index)
+
+
 def _session_exec_lock(session) -> threading.Lock:
     """The ONE execution lock of a session, attached on first use: every
     server/replica over the same session must serialize through the same

@@ -46,6 +46,18 @@ Serving metrics land in the session's registry under ``serve.*``
 queue-wait + batch-size histograms, device quarantine/reinstate
 transitions) and show up in ``session.metrics_snapshot()`` next to
 everything else.
+
+**Windowed telemetry** (obs/telemetry.py) sits on top of the cumulative
+counters: rolling p50/p95/p99 latency, queue wait, batch occupancy,
+shed/retry/abort rates and per-device utilization over the last
+``telemetry_window_s`` seconds; an optional SLO (``ServerConfig.slo``)
+evaluated into error-budget burn rates; a bounded per-request **flight
+recorder** dumped automatically on breaker trips, device quarantines,
+and compaction failures (``server.dump_flight_recorder()`` on demand).
+``health_report()`` is the structured rollup, ``stats()["telemetry"]``
+/ ``stats()["slo"]`` / ``stats()["batching"]`` the stats view, and
+``server.metrics_text()`` the Prometheus text exposition of the whole
+registry (windowed gauges included).
 """
 from __future__ import annotations
 
@@ -56,6 +68,7 @@ from typing import Any, Dict, List, Mapping, Optional
 
 from caps_tpu.obs import clock
 from caps_tpu.obs.lockgraph import make_lock
+from caps_tpu.obs.telemetry import ServingTelemetry, SLOConfig
 from caps_tpu.serve import batcher as _batcher
 from caps_tpu.serve.admission import AdmissionController
 from caps_tpu.serve.batcher import MicroBatcher
@@ -162,6 +175,19 @@ class ServerConfig:
     compaction_threshold_rows: Optional[int] = None
     #: cadence of the compactor's backlog checks
     compaction_interval_s: float = 0.05
+    #: serving SLO (obs/telemetry.py): a latency target + objectives
+    #: evaluated over the telemetry window into error-budget burn rates
+    #: (``health_report()``, ``slo.*`` gauges); None = no SLO evaluation
+    #: (windowed telemetry is still collected)
+    slo: Optional[SLOConfig] = None
+    #: rolling telemetry window: ``telemetry_buckets`` ring slots
+    #: spanning ``telemetry_window_s`` seconds, rotated on obs.clock
+    telemetry_window_s: float = 60.0
+    telemetry_buckets: int = 60
+    #: bounded ring of per-request flight records (the postmortem black
+    #: box, dumped on breaker-trip / quarantine / compaction-failure
+    #: and via ``dump_flight_recorder()``)
+    flight_recorder_size: int = 256
 
 
 class QueryServer:
@@ -182,10 +208,18 @@ class QueryServer:
         self._default_graph = graph if graph is not None \
             else session._ambient
         registry = session.metrics_registry
+        #: windowed telemetry + SLO + flight recorder (obs/telemetry.py):
+        #: rolling p50/p95/p99, error-budget burn rates, the per-request
+        #: black box, and the live ``telemetry.*``/``slo.*`` gauges
+        self.telemetry = ServingTelemetry(
+            registry, window_s=self.config.telemetry_window_s,
+            buckets=self.config.telemetry_buckets, slo=self.config.slo,
+            flight_recorder_size=self.config.flight_recorder_size)
         self.admission = AdmissionController(
             registry, max_queue=self.config.max_queue,
             per_priority_limits=self.config.per_priority_limits,
-            workers=self.config.devices or self.config.workers)
+            workers=self.config.devices or self.config.workers,
+            telemetry=self.telemetry)
         self.batcher = MicroBatcher(self.admission,
                                     max_batch=self.config.max_batch,
                                     window_s=self.config.batch_window_s)
@@ -236,7 +270,9 @@ class QueryServer:
             self.compactor = Compactor(
                 self._default_graph, registry,
                 threshold_rows=self.config.compaction_threshold_rows,
-                interval_s=self.config.compaction_interval_s)
+                interval_s=self.config.compaction_interval_s,
+                on_failure=lambda ex: self.telemetry.auto_dump(
+                    "compaction_failure"))
         if start:
             self.start()
 
@@ -292,6 +328,7 @@ class QueryServer:
             # exit once the (closed) queue is empty
             self.start()
         if not self._started:
+            self.telemetry.close()
             return True
         deadline = None if timeout is None else clock.now() + timeout
         for t in self._threads:
@@ -301,6 +338,11 @@ class QueryServer:
         self._threads = still_running
         if self.compactor is not None:
             self.compactor.stop()
+        if not still_running:
+            # fully stopped: the windowed gauges must not keep reading
+            # (or pinning) this server's telemetry — same contract as
+            # the admission depth gauge's deregistration
+            self.telemetry.close()
         return not still_running
 
     def __enter__(self) -> "QueryServer":
@@ -358,9 +400,11 @@ class QueryServer:
     def stats(self) -> Dict[str, Any]:
         """The ``serve.*`` slice of the metrics registry, unprefixed,
         plus the failure-containment summary (``health``, per-family
-        breaker states) and the per-device fault-domain view
+        breaker states), the per-device fault-domain view
         (``devices``: health, request counts, quarantine/reinstate
-        transition counters per replica)."""
+        transition counters per replica), the windowed telemetry and SLO
+        views (``telemetry`` / ``slo``), and micro-batch occupancy
+        (``batching``)."""
         snap = self._registry.snapshot()
         out = {k[len("serve."):]: v for k, v in snap.items()
                if k.startswith("serve.")}
@@ -369,7 +413,61 @@ class QueryServer:
         out["devices"] = self.devices.summary()
         out["compaction"] = (self.compactor.summary()
                              if self.compactor is not None else None)
+        out["telemetry"] = self.telemetry.summary()
+        out["slo"] = self.telemetry.slo_report()
+        out["batching"] = self._batching_stats(snap)
         return out
+
+    def _batching_stats(self, snap: Dict[str, Any]) -> Dict[str, Any]:
+        """Micro-batch occupancy (ROADMAP item 2's missing number):
+        cumulative members/batch from the ``serve.batch_size`` histogram
+        plus the window-averaged occupancy, and — on the TPU backend —
+        the fused executor's batch counters."""
+        batches = snap.get("serve.batch_size.count", 0)
+        members = snap.get("serve.batch_size.sum", 0.0)
+        out = {
+            "batches": batches,
+            "members": int(members),
+            "mean_occupancy": round(members / batches, 4) if batches
+            else 0.0,
+            "window_occupancy": self.telemetry.batch_occupancy(),
+        }
+        fused = getattr(self.session, "fused", None)
+        if fused is not None:
+            out["fused_batches"] = fused.batches
+            out["fused_batch_members"] = fused.batch_members
+        return out
+
+    def health_report(self) -> Dict[str, Any]:
+        """Structured serving health: the one-word :meth:`health` string
+        plus the windowed SLO evaluation (error-budget burn rates), the
+        telemetry window summary, and the breaker / device / compaction
+        detail — everything a capacity dashboard or an alerting rule
+        needs in one call."""
+        return {
+            "status": self.health(),
+            "slo": self.telemetry.slo_report(),
+            "window": self.telemetry.summary(),
+            "breakers": self.breaker.summary(),
+            "devices": self.devices.summary(),
+            "compaction": (self.compactor.summary()
+                           if self.compactor is not None else None),
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus text-exposition of the session registry — the
+        windowed ``telemetry.*``/``slo.*`` gauges are registered with
+        live callbacks, so the scrape includes them automatically."""
+        return self._registry.expose_text()
+
+    def dump_flight_recorder(self, reason: str = "manual"
+                             ) -> Dict[str, Any]:
+        """On-demand snapshot of the per-request flight ring (plan
+        family, device, attempts history, phase timings, outcome per
+        record).  Automatic dumps (breaker trip, device quarantine,
+        compaction failure) accumulate in
+        ``server.telemetry.flight_dumps``."""
+        return self.telemetry.dump_flight_recorder(reason)
 
     def health(self) -> str:
         """One-word serving health: ``healthy`` (all plan families
@@ -480,6 +578,7 @@ class QueryServer:
             wait_s = now - req.enqueued_t
             req.handle.info["queue_wait_s"] = wait_s
             self._queue_wait.observe(wait_s)
+            self.telemetry.note_queue_wait(wait_s)
             live.append(req)
         return live
 
@@ -543,6 +642,7 @@ class QueryServer:
                 probe.handle.info["batch_size"] = 1
                 self._batches.inc()
                 self._batch_hist.observe(1)
+                self.telemetry.note_batch(1)
                 outcome = self._execute_single(probe, 1, replica)
                 if isinstance(outcome, BaseException):
                     outcome = self._recover(probe, outcome, 1, replica)
@@ -559,6 +659,9 @@ class QueryServer:
                             f"failed half-open trial (retry after "
                             f"{self.breaker.cooldown_s:.3f}s)",
                             retry_after_s=self.breaker.cooldown_s))
+                    # the probe (and its fast-failed siblings) are in the
+                    # ring by now: the dump carries their attempt history
+                    self.telemetry.auto_dump("breaker_trip")
                     return
                 self.breaker.record_success(family)
                 self._finish(probe, outcome)
@@ -569,6 +672,7 @@ class QueryServer:
         n = len(live)
         self._batches.inc()
         self._batch_hist.observe(n)
+        self.telemetry.note_batch(n)
         for req in live:
             req.handle.info["batch_size"] = n
             req.handle.info["device"] = replica.index
@@ -597,8 +701,11 @@ class QueryServer:
                 except BaseException as ex:
                     outcomes = [ex]
             exec_s = clock.now() - t0
-        # feed the admission controller's retry_after estimator
+        # feed the admission controller's retry_after estimator and the
+        # telemetry window (service-time + per-device utilization)
         self.admission.observe_service(exec_s / n)
+        self.telemetry.note_service(exec_s / n)
+        self.telemetry.note_device_busy(replica.index, exec_s)
         # per-device fault-domain bookkeeping on the RAW outcomes: the
         # device that produced a failure owns it, whatever device the
         # recovery below lands on
@@ -621,10 +728,11 @@ class QueryServer:
             # breaker bookkeeping on the request's FINAL outcome;
             # cancellation/deadline expiry is the budget's verdict, not
             # the family's
+            tripped = False
             if isinstance(outcome, BaseException):
                 if not isinstance(outcome, CancellationError):
-                    if self.breaker.record_failure(family, outcome) \
-                            and not req.handle.info.get("quarantined"):
+                    tripped = self.breaker.record_failure(family, outcome)
+                    if tripped and not req.handle.info.get("quarantined"):
                         # this failure tripped the family open: evict its
                         # shared cached state so the half-open trial (and
                         # the eventual recovery) re-plans from scratch —
@@ -633,6 +741,10 @@ class QueryServer:
             else:
                 self.breaker.record_success(family)
             self._finish(req, outcome)
+            if tripped:
+                # AFTER the finish: the tripping request is in the
+                # flight ring, so the dump carries its attempt history
+                self.telemetry.auto_dump("breaker_trip")
 
     def _note_device_outcomes(self, replica: DeviceReplica,
                               outcomes: List[Any]) -> None:
@@ -644,7 +756,10 @@ class QueryServer:
             if isinstance(outcome, CancellationError):
                 continue
             if isinstance(outcome, BaseException):
-                self.devices.record_failure(replica, outcome)
+                if self.devices.record_failure(replica, outcome):
+                    # this failure quarantined the device: black-box the
+                    # in-flight picture for the postmortem
+                    self.telemetry.auto_dump("device_quarantine")
             else:
                 self.devices.record_success(replica)
 
@@ -692,6 +807,7 @@ class QueryServer:
                     break
                 attempts[-1]["backoff_s"] = backoff
                 self._retries.inc()
+                self.telemetry.note_retry()
                 tracer = self.session.tracer
                 if tracer.enabled:
                     tracer.event("retry.attempt", attempt=executions,
@@ -771,7 +887,10 @@ class QueryServer:
                 attribute_device(ex, replica.index)
                 out = ex
             finally:
-                self.admission.observe_service(clock.now() - t0)
+                exec_s = clock.now() - t0
+        self.admission.observe_service(exec_s)
+        self.telemetry.note_service(exec_s)
+        self.telemetry.note_device_busy(replica.index, exec_s)
         self._note_device_outcomes(replica, [out])
         return out
 
@@ -815,6 +934,7 @@ class QueryServer:
         """Materialize (deadline-checked) and complete one handle."""
         if isinstance(outcome, BaseException):
             self._count_failure(outcome)
+            self._flight(req, outcome)
             req.handle._complete(exception=outcome)
             return
         rows = None
@@ -826,12 +946,60 @@ class QueryServer:
                     req.scope.raise_if_done("materialize")
         except BaseException as ex:
             self._count_failure(ex)
+            self._flight(req, ex)
             req.handle._complete(exception=ex)
             return
         req.handle.info["latency_s"] = req.scope.elapsed()
         self._latency.observe(req.handle.info["latency_s"])
         self._completed.inc()
+        self._flight(req, None)
         req.handle._complete(result=outcome, rows=rows)
+
+    def _family_label(self, req: Request) -> str:
+        """Human-meaningful plan-family label for telemetry and the
+        flight recorder: the normalized query text for batchable
+        requests (the batch key's middle element), else mode + raw
+        text."""
+        if req.batch_key is not None:
+            return str(req.batch_key[1])[:120]
+        return f"{req.mode or 'solo'}:{req.query[:100]}"
+
+    def _flight(self, req: Request, exc: Optional[BaseException]) -> None:
+        """One finished request's black-box record + windowed outcome
+        note.  Cancellation AND deadline expiry count as aborts
+        (excluded from availability — the budget's verdict, not the
+        server's, same exemption the breaker and device ladder apply);
+        every other failure counts against availability."""
+        info = req.handle.info
+        latency_s = req.scope.elapsed()
+        family = self._family_label(req)
+        if exc is None:
+            kind = "ok"
+        elif isinstance(exc, CancellationError):
+            kind = "abort"
+        else:
+            kind = "error"
+        self.telemetry.note_result(family, latency_s, kind)
+        rec: Dict[str, Any] = {
+            "request_id": req.request_id,
+            "family": family,
+            "priority": req.priority,
+            "device": info.get("device"),
+            "batch_size": info.get("batch_size"),
+            "queue_wait_s": info.get("queue_wait_s"),
+            "latency_s": round(latency_s, 6),
+            "phase": req.scope.phase,
+            "outcome": "ok" if exc is None else type(exc).__name__,
+        }
+        if info.get("snapshot_version") is not None:
+            rec["snapshot_version"] = info["snapshot_version"]
+        if exc is not None:
+            rec["error"] = str(exc)[:200]
+        if info.get("attempts"):
+            rec["attempts"] = info["attempts"]
+        if info.get("quarantined"):
+            rec["quarantined"] = True
+        self.telemetry.recorder.record(rec)
 
     def _count_failure(self, ex: BaseException) -> None:
         if isinstance(ex, DeadlineExceeded):

@@ -548,10 +548,16 @@ def test_live_repo_static_lock_graph_has_serve_edges():
     edges, index, _info = static_lock_graph(load_project(REPO))
     assert "devices.DeviceReplica.lock" in index.ids
     assert "plan_cache.PlanCache._lock" in index.ids
-    # the serve tier's real nesting is visible statically: the device
-    # stream lock is held around admission's service-time EMA update
+    assert "telemetry.ServingTelemetry._lock" in index.ids
+    # the serve tier's real nesting is visible statically: admission's
+    # condition is held while the shed is noted into the telemetry
+    # window / the windowed service time is read for retry_after (the
+    # device stream lock no longer nests the admission condition — the
+    # service-time fold moved outside it)
+    assert ("admission.AdmissionController._cond",
+            "telemetry.ServingTelemetry._lock") in edges
     assert ("devices.DeviceReplica.lock",
-            "admission.AdmissionController._cond") in edges
+            "devices.DeviceReplica._graphs_lock") in edges
 
 
 def test_metrics_doc_has_no_drift():
