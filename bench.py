@@ -482,7 +482,10 @@ def run_serve_config(on_tpu: bool):
     server = QueryServer(session, graph=graph, config=ServerConfig(
         workers=2, max_queue=256, max_batch=16, batch_window_s=0.001,
         slo=SLOConfig(latency_target_s=1.0, latency_objective=0.95,
-                      availability_objective=0.99)))
+                      availability_objective=0.99),
+        # capture everything: the bench proves the slow-query ledger
+        # pipeline end to end (ISSUE 10 acceptance)
+        slow_query_threshold_s=0.0))
     latencies, errors = [], []
 
     def client(i):
@@ -645,6 +648,47 @@ def run_serve_config(on_tpu: bool):
         assert sample_re.match(line), f"unparseable exposition: {line!r}"
         samples += 1
     _result["expose_text_samples"] = samples
+
+    # -- resource ledger: compile + memory + slow-query log (ISSUE 10) -
+    compile_view = server.stats()["compile"]
+    _result.update({
+        "compile_total_s": compile_view["total_s"],
+        "compile_events": compile_view["events"],
+        "compile_recompiles": compile_view["recompiles"],
+        # per-family compile seconds (the AOT-warmup target list)
+        "compile_by_family": {fam[:60]: e["total_s"]
+                              for fam, e in
+                              compile_view["by_family"].items()},
+    })
+    assert compile_view["total_s"] > 0, "no compile charge recorded"
+    mem = server.stats()["memory"]
+    _result.update({
+        "mem_plan_cache_bytes": mem["plan_cache_bytes"],
+        "mem_string_pool_bytes": mem["string_pool_bytes"],
+        "mem_graph_bytes": mem["graphs"].get("default", {}).get("bytes", 0),
+        "mem_device_bytes_in_use": mem["device_bytes_in_use"],
+        "mem_devices_reporting": sum(
+            1 for d in mem["devices"].values() if d.get("available")),
+    })
+    assert mem["plan_cache_bytes"] > 0 and _result["mem_graph_bytes"] > 0
+    slow = [r for r in server.slow_queries()
+            if r["outcome"] == "ok" and r["ledger"]["bytes_in"] > 0]
+    assert slow, "no slow-query record with a non-empty ledger captured"
+    srec = slow[0]
+    assert srec["ledger"]["peak_rows"] > 0 and srec.get("plan") \
+        and srec.get("operators"), "slow record missing detail"
+    _result.update({
+        "slowlog_records": len(server.slow_queries()),
+        "slowlog_sample_ledger": srec["ledger"],
+        "event_log_events": sorted({e["event"] for e in server.events()}),
+    })
+    # warmed server: every hot family compiled on this process
+    warm = server.warmup_report()
+    assert warm["cold_families"] == [], warm["cold_families"]
+    _result.update({
+        "warmup_hot_families": warm["hot_families"],
+        "warmup_cold_hot_families": len(warm["cold_families"]),
+    })
     server.shutdown()
     _emit()
 

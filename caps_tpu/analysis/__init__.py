@@ -27,6 +27,10 @@ clock-discipline   every timing read goes through caps_tpu.obs.clock —
 metric-names       dotted-prefix conventions, name->kind uniqueness,
                    histogram snapshot collisions; generates
                    docs/metrics.md (CI drift-checked)
+structured-log     every structured-log emit site (obs/log.py contract)
+                   carries the request_id/family correlation fields, so
+                   events always join with flight dumps and slow-query
+                   records (PR 9)
 =================  =========================================================
 
 Run ``python -m caps_tpu.analysis`` (or the ``capslint`` console
@@ -50,6 +54,7 @@ from caps_tpu.analysis import purity as _purity            # noqa: F401
 from caps_tpu.analysis import taxonomy as _taxonomy        # noqa: F401
 from caps_tpu.analysis import clocks as _clocks            # noqa: F401
 from caps_tpu.analysis import metric_names as _metric_names  # noqa: F401
+from caps_tpu.analysis import structlog as _structlog      # noqa: F401
 
 from caps_tpu.analysis.metric_names import (check_metrics_doc,
                                             generate_metrics_doc,

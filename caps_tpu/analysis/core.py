@@ -98,7 +98,13 @@ class AnalysisConfig:
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
         "faults", "fused", "dist_join", "obs", "backend", "tracer",
-        "updates", "compaction", "telemetry", "slo", "opstats"})
+        "updates", "compaction", "telemetry", "slo", "opstats",
+        "compile", "mem", "slowlog"})
+    #: the structured event log module (obs/log.py) and the correlation
+    #: fields every emit site must pass — the structured-log pass's
+    #: contract (a missing module is a finding, not a silent skip)
+    structured_log_rel: str = "caps_tpu/obs/log.py"
+    structured_log_fields: Tuple[str, ...] = ("request_id", "family")
     #: extra tracer-purity roots: every method with one of these names in
     #: the listed dirs is treated as reached by the fused record path
     #: (operator ``_compute`` bodies are recorded and replayed — clock

@@ -63,6 +63,22 @@ class StringPool:
     def decode_many(self, codes) -> List[Optional[str]]:
         return [self.decode(int(c)) for c in codes]
 
+    # -- memory accounting ---------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        """Approximate host bytes of the interned strings plus index
+        overhead — the memory ledger's ``mem.string_pool_bytes`` input
+        (obs/ledger.py).  Rides the per-version ``lengths_array`` cache,
+        so repeated gauge reads between interns are O(1)."""
+        n = len(self)
+        if not n:
+            return 0
+        try:
+            return int(self.lengths_array().sum()) + 64 * n
+        except Exception:  # pragma: no cover — accounting must not fail
+            return 64 * n
+
     # -- failure containment -------------------------------------------------
 
     def mark(self) -> int:

@@ -58,9 +58,33 @@ class TPUCypherSession(RelationalCypherSession):
             result = super()._cypher_on_graph(graph, query, parameters)
         else:
             key = self.fused.key(graph, query, dict(parameters or {}))
+            from caps_tpu.obs.compile import current_charges
+            charges = current_charges()
+            n0 = len(charges) if charges is not None else 0
             result = self.fused.run(
                 key, lambda: super(TPUCypherSession, self)._cypher_on_graph(
                     graph, query, parameters))
+            if (key is not None and self.fused.last_mode == "record"
+                    and result.metrics is not None):
+                # Compile ledger (obs/compile.py): a record-mode run is
+                # THE fused compile boundary — its execute phase traces
+                # and XLA-compiles every operator program.  Replays
+                # charge nothing; a post-quarantine re-record of the
+                # same (graph, params) shape counts as a re-compile.
+                # Inner EXECUTE-phase boundaries (count-fused builds,
+                # dist-join program misses) already charged themselves
+                # above — subtract them so a query's compile seconds
+                # sum the wall clock once, not twice ("plan" charges
+                # never overlap: execute_s excludes the plan phase).
+                exec_s = float(result.metrics.get("execute_s") or 0.0)
+                if charges is not None:
+                    exec_s -= sum(c["seconds"] for c in charges[n0:]
+                                  if c["kind"] != "plan")
+                import hashlib
+                sig = hashlib.sha1(
+                    repr(key[2]).encode()).hexdigest()[:10]
+                obs.compile_charge("fused_record", max(0.0, exec_s),
+                                   shape=f"g{key[0]}:p{sig}")
         if result.metrics is not None:
             result.metrics["ici_bytes"] = be.ici_bytes - before[0]
             result.metrics["dist_joins"] = be.dist_joins - before[1]

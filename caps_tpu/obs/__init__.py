@@ -20,8 +20,15 @@ Design constraints:
   (enforced by ``scripts/check_no_naked_timers.py``).
 """
 from caps_tpu.obs import clock, lockgraph
+from caps_tpu.obs.compile import (CompileLedger, attributed as
+                                  compile_attributed, charge as
+                                  compile_charge, charged as compile_charged,
+                                  global_compile_ledger)
 from caps_tpu.obs.export import (chrome_trace_events, write_chrome_trace,
                                  write_jsonl)
+from caps_tpu.obs.ledger import (MemoryLedger, device_memory,
+                                 snapshot_footprint)
+from caps_tpu.obs.log import EventLog, SlowQueryLog
 from caps_tpu.obs.metrics import (MetricsRegistry, diff_snapshots,
                                   global_registry)
 from caps_tpu.obs.profile import (find_executed_rows, profile_tree,
@@ -40,4 +47,8 @@ __all__ = [
     "profile_tree", "render_profile", "tag_timing", "find_executed_rows",
     "SLOConfig", "ServingTelemetry", "FlightRecorder", "OpStatsStore",
     "RollingCounter", "RollingHistogram",
+    "CompileLedger", "compile_attributed", "compile_charge",
+    "compile_charged", "global_compile_ledger",
+    "MemoryLedger", "device_memory", "snapshot_footprint",
+    "EventLog", "SlowQueryLog",
 ]

@@ -1,0 +1,214 @@
+"""Memory ledger: byte accounting for the engine's resident state.
+
+Per-operator ``bytes_in`` (relational/ops.py) measures bytes *moved*
+per execution; nothing so far measured bytes *held* — the plan cache,
+the string pool, base CSR + delta-store tables per snapshot version,
+and actual device HBM.  The compactor triggers on row counts, capacity
+planning has no byte signal, and ROADMAP item 4's cost model needs
+observed footprints.  This module is that accounting layer:
+
+* :class:`MemoryLedger` — one per session: live ``mem.*`` gauges
+  (plan-cache bytes via the extended ``_plan_nbytes``, string-pool
+  bytes via ``StringPool.nbytes``, tracked-graph bytes, device bytes in
+  use) registered in the session registry so they ride
+  ``metrics_snapshot()`` and the Prometheus exposition;
+* :func:`snapshot_footprint` — duck-typed byte breakdown of any graph:
+  plain scan graphs report one total, versioned graphs / snapshots
+  split base vs delta bytes per snapshot version (the byte-based
+  compaction trigger's input — ``GraphSnapshot.delta_nbytes``);
+* :func:`device_memory` — per-device live bytes via
+  ``jax.Device.memory_stats()`` with graceful CPU fallback (platforms
+  without allocator stats report ``{"available": False}`` instead of
+  lying with zeros).
+
+Everything here is approximate-but-honest host arithmetic: table
+``nbytes`` walks column buffers without syncing the device, and a probe
+that cannot measure says so rather than reporting 0.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Dict, Optional
+
+from caps_tpu.obs.lockgraph import make_lock
+
+
+def tables_nbytes(entity_tables) -> int:
+    """Summed ``table.nbytes`` over a graph's entity-table sequence
+    (never raises: a table that cannot report counts 0)."""
+    n = 0
+    for et in entity_tables or ():
+        t = getattr(et, "table", et)
+        try:
+            n += int(t.nbytes)
+        except Exception:
+            pass
+    return n
+
+
+def _scan_bytes(graph) -> int:
+    return (tables_nbytes(getattr(graph, "node_tables", ()))
+            + tables_nbytes(getattr(graph, "rel_tables", ())))
+
+
+def snapshot_footprint(graph) -> Dict[str, Any]:
+    """Byte breakdown of one graph.  Versioned handles resolve to their
+    current snapshot; snapshots split base vs delta (delta tables +
+    tombstone id sets) and carry their version; plain graphs report one
+    total under ``bytes``."""
+    if getattr(graph, "graph_is_versioned", False):
+        current = getattr(graph, "current", None)
+        if current is not None:
+            return snapshot_footprint(current())
+    state = getattr(graph, "state", None)
+    base = getattr(graph, "base", None)
+    if state is not None and base is not None:
+        base_b = _scan_bytes(base)
+        delta_nbytes = getattr(graph, "delta_nbytes", None)
+        delta_b = delta_nbytes() if delta_nbytes is not None else 0
+        return {"snapshot_version": getattr(graph, "snapshot_version", 0),
+                "base_bytes": base_b, "delta_bytes": delta_b,
+                "delta_rows": state.delta_rows,
+                "bytes": base_b + delta_b}
+    return {"bytes": _scan_bytes(graph)}
+
+
+def device_memory() -> Dict[str, Dict[str, Any]]:
+    """Per-device allocator stats from ``jax.Device.memory_stats()``.
+    Devices whose runtime exposes no stats (the CPU backend on most jax
+    versions) report ``{"available": False}`` — an honest "cannot
+    measure", never a fake zero."""
+    try:
+        import jax
+        devices = jax.devices()
+    except Exception:  # pragma: no cover — jax missing/unusable
+        return {}
+    out: Dict[str, Dict[str, Any]] = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            out[str(d)] = {"available": False}
+            continue
+        entry: Dict[str, Any] = {"available": True}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                entry[k] = int(stats[k])
+        out[str(d)] = entry
+    return out
+
+
+def device_bytes_in_use() -> int:
+    """Summed live bytes across devices that can report (0 when none
+    can — pair with :func:`device_memory` to tell "idle" from "blind")."""
+    return sum(e.get("bytes_in_use", 0) for e in device_memory().values())
+
+
+class MemoryLedger:
+    """Byte accounting for one session's resident state.
+
+    Registers live ``mem.*`` gauges in ``registry`` (callbacks read the
+    session's plan cache / string pool / tracked graphs at snapshot
+    time) and serves the structured :meth:`report` the serving tier
+    exposes as ``stats()["memory"]``.  Graphs are tracked by weakref —
+    a dropped graph falls out of the ledger instead of being pinned by
+    it (same contract as the ``updates.delta_rows`` gauge)."""
+
+    def __init__(self, registry=None, session=None):
+        self._session = (weakref.ref(session) if session is not None
+                         else lambda: None)
+        self._graphs: Dict[str, Any] = {}  # name -> weakref
+        self._lock = make_lock("ledger.MemoryLedger._lock")
+        if registry is not None:
+            registry.gauge("mem.plan_cache_bytes", fn=self.plan_cache_bytes)
+            registry.gauge("mem.string_pool_bytes",
+                           fn=self.string_pool_bytes)
+            registry.gauge("mem.tracked_graph_bytes",
+                           fn=self.tracked_graph_bytes)
+            registry.gauge("mem.device_bytes_in_use", fn=device_bytes_in_use)
+
+    # -- tracked graphs -------------------------------------------------
+
+    def track(self, name: str, graph) -> None:
+        """Account ``graph`` under ``name`` (weakly; re-tracking a name
+        replaces it).  The serving tier tracks its default graph."""
+        try:
+            ref = weakref.ref(graph)
+        except TypeError:  # pragma: no cover — non-weakrefable graph
+            ref = (lambda g=graph: g)
+        with self._lock:
+            self._graphs[name] = ref
+
+    def untrack(self, name: str) -> None:
+        with self._lock:
+            self._graphs.pop(name, None)
+
+    def untrack_if(self, name: str, graph) -> bool:
+        """Untrack ``name`` only while it still refers to ``graph`` — a
+        later :meth:`track` that replaced the name keeps its entry (two
+        servers on one session: the dead one's release must not drop
+        the live one's accounting)."""
+        with self._lock:
+            ref = self._graphs.get(name)
+            if ref is not None and ref() is graph:
+                del self._graphs[name]
+                return True
+        return False
+
+    def _live_graphs(self) -> Dict[str, Any]:
+        with self._lock:
+            refs = dict(self._graphs)
+        out = {}
+        for name, ref in refs.items():
+            g = ref()
+            if g is not None:
+                out[name] = g
+        return out
+
+    # -- gauge callbacks ------------------------------------------------
+
+    def plan_cache_bytes(self) -> int:
+        session = self._session()
+        cache = getattr(session, "plan_cache", None)
+        if cache is None:
+            return 0
+        try:
+            return int(cache.stats()["bytes"])
+        except Exception:  # pragma: no cover — accounting must not fail
+            return 0
+
+    def string_pool_bytes(self) -> int:
+        session = self._session()
+        pool = getattr(getattr(session, "backend", None), "pool", None)
+        if pool is None:
+            return 0
+        try:
+            return int(pool.nbytes)
+        except Exception:  # pragma: no cover
+            return 0
+
+    def tracked_graph_bytes(self) -> int:
+        return sum(snapshot_footprint(g)["bytes"]
+                   for g in self._live_graphs().values())
+
+    # -- the structured view --------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        """The full byte picture: plan cache, string pool, per-tracked-
+        graph footprints (base/delta split per snapshot version), and
+        per-device live bytes — ``stats()["memory"]`` on the server."""
+        graphs = {name: snapshot_footprint(g)
+                  for name, g in self._live_graphs().items()}
+        devices = device_memory()
+        return {
+            "plan_cache_bytes": self.plan_cache_bytes(),
+            "string_pool_bytes": self.string_pool_bytes(),
+            "graphs": graphs,
+            "tracked_graph_bytes": sum(f["bytes"]
+                                       for f in graphs.values()),
+            "devices": devices,
+            "device_bytes_in_use": sum(e.get("bytes_in_use", 0)
+                                       for e in devices.values()),
+        }

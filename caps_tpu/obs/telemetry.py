@@ -290,6 +290,9 @@ class OpStatsStore:
         self.max_families = max(1, int(max_families))
         self.divergence_factor = max(1.0, float(divergence_factor))
         self._families: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        #: total per-operator entries folded in (the health_report
+        #: ``opstats`` section reads it without needing the registry)
+        self.recorded = 0
         self._lock = make_lock("telemetry.OpStatsStore._lock")
         self._recorded_c = (registry.counter("opstats.recorded")
                             if registry is not None else None)
@@ -306,6 +309,7 @@ class OpStatsStore:
             return
         diverged = 0
         with self._lock:
+            self.recorded += len(op_metrics)
             fam = self._families.pop(family, None)
             if fam is None:
                 fam = {}
@@ -371,7 +375,7 @@ class OpStatsStore:
             div = sum(st["divergences"] for v in self._families.values()
                       for st in v.values())
             return {"families": len(self._families), "operators": ops,
-                    "divergences": div}
+                    "recorded": self.recorded, "divergences": div}
 
 
 # -- the serving telemetry hub -----------------------------------------------
@@ -424,6 +428,11 @@ class ServingTelemetry:
         self._within_slo = ctr()
         self._shed = ctr()
         self._retries = ctr()
+        # compile charges (obs/compile.py): events + seconds over the
+        # window — a warmed server shows 0.0 here, a re-compile storm
+        # shows up immediately
+        self._compile_events = ctr()
+        self._compile_s = ctr()
         self._device_busy: Dict[int, RollingCounter] = {}
         self._family_latency: Dict[str, RollingHistogram] = {}
         self.recorder = FlightRecorder(capacity=flight_recorder_size)
@@ -489,6 +498,8 @@ class ServingTelemetry:
                            fn=window_gauge("retry_rate"))
             registry.gauge("telemetry.error_rate",
                            fn=window_gauge("error_rate"))
+            registry.gauge("telemetry.compile_s",
+                           fn=window_gauge("window_compile_s"))
         if need_slo:
             registry.gauge("slo.latency_compliance",
                            fn=slo_gauge("latency_compliance"))
@@ -536,6 +547,14 @@ class ServingTelemetry:
     def note_retry(self) -> None:
         with self._lock:
             self._retries.inc(clock.now())
+
+    def note_compile(self, seconds: float) -> None:
+        """One request's compile charge (the per-query
+        ``compile_s_charged`` the session stamps — obs/compile.py)."""
+        now = clock.now()
+        with self._lock:
+            self._compile_events.inc(now)
+            self._compile_s.inc(now, max(0.0, float(seconds)))
 
     def note_device_busy(self, device_index: int, busy_s: float) -> None:
         with self._lock:
@@ -611,6 +630,11 @@ class ServingTelemetry:
         with self._lock:
             return round(self._errors.total(now) / self._span(now), 4)
 
+    def window_compile_s(self) -> float:
+        """Compile seconds charged inside the window (0.0 warmed)."""
+        with self._lock:
+            return round(self._compile_s.total(clock.now()), 6)
+
     def batch_occupancy(self) -> float:
         """Window-averaged micro-batch occupancy (members per batch);
         0.0 with no batches in the window."""
@@ -647,6 +671,10 @@ class ServingTelemetry:
                     "p95_s": self._queue_wait.quantile(now, 0.95),
                 },
                 "batch_occupancy": self._occupancy.mean(now) or 0.0,
+                "compile": {
+                    "events": int(self._compile_events.total(now)),
+                    "seconds": round(self._compile_s.total(now), 6),
+                },
                 "rates_per_s": {
                     "completed": round(ok / span, 4),
                     "errors": round(errors / span, 4),

@@ -179,6 +179,7 @@ def dense_segment_agg(codes: jnp.ndarray, ok: jnp.ndarray,
 
 @functools.lru_cache(maxsize=256)
 def _sharded_agg_fn(mesh, num_segments: int, kind: str, interpret: bool):
+    from caps_tpu.obs.compile import charged as _compile_charged
     from caps_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -198,9 +199,12 @@ def _sharded_agg_fn(mesh, num_segments: int, kind: str, interpret: bool):
 
     # check_vma=False: pallas_call outputs don't carry varying-mesh-axis
     # metadata, so shard_map's vma checker can't see through them.
-    return jax.jit(shard_map(body, mesh=mesh,
-                             in_specs=(P(axes), P(axes), P(axes)),
-                             out_specs=P(), check_vma=False))
+    # An lru_cache miss here is a compile boundary (obs/compile.py).
+    with _compile_charged("dist_join",
+                          shape=f"segagg:{num_segments}:{kind}"):
+        return jax.jit(shard_map(body, mesh=mesh,
+                                 in_specs=(P(axes), P(axes), P(axes)),
+                                 out_specs=P(), check_vma=False))
 
 
 def dense_segment_agg_sharded(mesh, axis: str, codes, ok, values,

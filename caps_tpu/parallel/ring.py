@@ -23,6 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from caps_tpu.obs.compile import charged as _compile_charged
 from caps_tpu.parallel.collectives import note_collective
 from caps_tpu.parallel.compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -446,8 +447,13 @@ def ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok, tmask,
 @functools.lru_cache(maxsize=128)
 def ring_varexpand_cached(mesh: Mesh, n_nodes: int, lengths: tuple,
                           axis: str = "shard", correction: str = "loops"):
-    """Memoized make_ring_varexpand (compiled program reuse per shape)."""
-    return make_ring_varexpand(mesh, n_nodes, lengths, axis, correction)
+    """Memoized make_ring_varexpand (compiled program reuse per shape).
+    A miss is a compile boundary: it charges the compile ledger
+    (obs/compile.py) under the executing query's family."""
+    with _compile_charged("dist_join",
+                          shape=f"varexpand:{n_nodes}:{lengths}:"
+                                f"{correction}"):
+        return make_ring_varexpand(mesh, n_nodes, lengths, axis, correction)
 
 
 @functools.lru_cache(maxsize=32)
@@ -455,21 +461,28 @@ def ring_varexpand_single(lengths: tuple, correction: str = "loops"):
     """Single-device matrix var-expand: the same SpMV-hop computation as
     the ring body, without collectives, as one jitted program (the
     VarExpand matrix strategy off-mesh).  One wrapper per (lengths,
-    correction) — jax's own trace cache handles the shapes."""
-    @jax.jit
-    def fn(f0, edge_src, edge_dst, edge_ok, tmask):
-        return ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok,
-                                        tmask, lengths, correction)
+    correction) — jax's own trace cache handles the shapes.  A miss
+    charges the compile ledger (the jit wrapper build; the per-shape
+    trace+compile lands on the first dispatch)."""
+    with _compile_charged("dist_join",
+                          shape=f"varexpand1:{lengths}:{correction}"):
+        @jax.jit
+        def fn(f0, edge_src, edge_dst, edge_ok, tmask):
+            return ring_varexpand_reference(f0, edge_src, edge_dst, edge_ok,
+                                            tmask, lengths, correction)
 
-    return fn
+        return fn
 
 
 @functools.lru_cache(maxsize=128)
 def ring_khop_cached(mesh: Mesh, n_nodes: int, n_hops: int,
                      axis: str = "shard", masked: bool = False):
     """Memoized make_ring_khop: repeat queries reuse the traced + compiled
-    shard_map program instead of re-jitting per call."""
-    return make_ring_khop(mesh, n_nodes, n_hops, axis, masked)
+    shard_map program instead of re-jitting per call.  A miss charges
+    the compile ledger (obs/compile.py)."""
+    with _compile_charged("dist_join",
+                          shape=f"khop:{n_nodes}:{n_hops}:{masked}"):
+        return make_ring_khop(mesh, n_nodes, n_hops, axis, masked)
 
 
 def ring_khop_reference(seed_counts, edge_src, edge_dst, edge_ok,

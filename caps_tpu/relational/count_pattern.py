@@ -40,6 +40,7 @@ import numpy as np
 from caps_tpu.ir import exprs as E
 from caps_tpu.ir.pattern import Direction
 from caps_tpu.logical import ops as L
+from caps_tpu.obs.compile import charged as _compile_charged
 from caps_tpu.okapi.types import CTInteger
 from caps_tpu.relational.header import RecordHeader
 from caps_tpu.relational.ops import RelationalOperator
@@ -421,7 +422,8 @@ class CountPatternOp(RelationalOperator):
         entry = backend.fused_count_fns.get(key)
         if entry is _NO_FUSE:
             return None
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             # Build outside any record/replay scope: the one-time scan and
             # sort syncs must not leak into a fused-executor recording (a
             # replay would never repeat them).
@@ -448,6 +450,17 @@ class CountPatternOp(RelationalOperator):
             x.nbytes for x in jax.tree_util.tree_leaves(args)
             if hasattr(x, "nbytes")) or getattr(fn, "nbytes_in", 0)
         self.strategy = "fused-spmv"
+        if fresh:
+            # Compile ledger (obs/compile.py): a fused_count_fns miss is
+            # a compile boundary — the closure build plus the FIRST
+            # dispatch (where jax traces + XLA-compiles the program).
+            # Cache hits below charge nothing.
+            import hashlib
+            sig = hashlib.sha1(
+                repr(self._plan_sig()).encode()).hexdigest()[:10]
+            with _compile_charged("count_fused", shape=f"g{gk}:{sig}"):
+                out = fn(*args)
+            return out, valid
         return fn(*args), valid
 
     def _plan_sig(self):

@@ -269,7 +269,15 @@ def reset_plan(root) -> None:
         stack.extend(op.children)
 
 
-def _plan_nbytes(plan: Dict[str, str], root) -> int:
+def _plan_nbytes(plan: Dict[str, str], root, context=None,
+                 catalog_deps=()) -> int:
+    """Approximate host bytes a cached plan entry keeps resident: the
+    pretty plan texts, a per-operator object estimate, the runtime
+    context's retained parameter bindings (rebind swaps them but the
+    LAST run's values stay referenced between executions), and the
+    catalog-dependency tuples.  The input to ``plan_cache.stats()
+    ["bytes"]`` and the memory ledger's ``mem.plan_cache_bytes`` gauge
+    (obs/ledger.py)."""
     n_ops, seen, stack = 0, set(), [root]
     while stack:
         op = stack.pop()
@@ -278,7 +286,15 @@ def _plan_nbytes(plan: Dict[str, str], root) -> int:
         seen.add(id(op))
         n_ops += 1
         stack.extend(op.children)
-    return sum(len(s) for s in plan.values()) + 512 * n_ops
+    n = sum(len(s) for s in plan.values()) + 512 * n_ops
+    if context is not None:
+        try:
+            n += sum(len(str(k)) + len(repr(v))
+                     for k, v in context.parameters.items())
+        except Exception:  # pragma: no cover — accounting must not fail
+            pass
+    n += 128 * len(catalog_deps)
+    return n
 
 
 class PlanCache:

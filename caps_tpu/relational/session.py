@@ -326,12 +326,23 @@ class RelationalCypherSession(CypherSession):
         # planner (ROADMAP item 4) reads.  Fused-replay aware for free:
         # the entries recorded are the same ones PROFILE annotates.
         self.op_stats = obs.OpStatsStore(registry=self.metrics_registry)
+        # Compile telemetry (obs/compile.py): every compile boundary —
+        # the cold plan phase here, fused record runs on the TPU
+        # backend, count-pushdown / dist-join program builds — charges
+        # this ledger per plan family (compile.* counters, the
+        # warmup_report substrate).
+        self.compile_ledger = obs.CompileLedger(
+            registry=self.metrics_registry)
         self._profiling = False
         # Prepared-statement plan cache (relational/plan_cache.py): keyed
         # value-independently; catalog mutations evict dependent entries.
         self.plan_cache = PlanCache(self.config.plan_cache_size,
                                     enabled=self.config.use_plan_cache,
                                     registry=self.metrics_registry)
+        # Memory ledger (obs/ledger.py): live mem.* gauges over the plan
+        # cache, string pool, tracked graphs, and device allocator stats.
+        self.memory_ledger = obs.MemoryLedger(
+            registry=self.metrics_registry, session=self)
         # Scoped catalog eviction: a mutation of graph X drops exactly
         # X's dependents from the plan cache (okapi/catalog.py
         # dep_token) — unrelated graphs' cached plans survive.
@@ -440,20 +451,44 @@ class RelationalCypherSession(CypherSession):
             return self._explain_on_graph(graph, body, parameters)
         if mode == "profile":
             return self._profile_on_graph(graph, body, parameters)
-        with self._observed():
-            result = self._cypher_on_graph(graph, query, parameters)
-        if self.config.determinism_check and result.records is not None:
-            # SURVEY.md §5.2: deterministic replay — run the same query a
-            # second time and compare multiset digests of the results.
-            again = self._cypher_on_graph(graph, query, parameters)
-            d1 = result_digest(result)
-            d2 = result_digest(again)
-            if d1 != d2:
-                raise NondeterministicResultError(
-                    f"query produced different results on replay "
-                    f"({d1[:12]} vs {d2[:12]}): {query!r}")
-            result.metrics["determinism_digest"] = d1
+        # Compile attribution (obs/compile.py): every compile boundary
+        # crossed below — the cold plan phase, a fused record run, a
+        # count-pushdown or dist-join program build — charges the
+        # session ledger under THIS query's plan-cache family, and the
+        # per-query total is stamped into the result metrics (the
+        # serving tier copies it into the request's ledger dict).
+        with obs.compile_attributed(self.compile_ledger,
+                                    normalize_query(query)) as charges:
+            with self._observed():
+                result = self._cypher_on_graph(graph, query, parameters)
+            if self.config.determinism_check and result.records is not None:
+                # SURVEY.md §5.2: deterministic replay — run the same
+                # query a second time and compare multiset digests.
+                again = self._cypher_on_graph(graph, query, parameters)
+                d1 = result_digest(result)
+                d2 = result_digest(again)
+                if d1 != d2:
+                    raise NondeterministicResultError(
+                        f"query produced different results on replay "
+                        f"({d1[:12]} vs {d2[:12]}): {query!r}")
+                result.metrics["determinism_digest"] = d1
+        self._stamp_compile_charges(result, charges)
         return result
+
+    @staticmethod
+    def _stamp_compile_charges(result, charges) -> None:
+        """Per-query compile accounting onto the result metrics:
+        ``compile_s_charged`` is ALWAYS present (0.0 on a fully warmed
+        path — the serving tier and the replay tests read it), the
+        per-charge detail only when something actually compiled."""
+        if result.metrics is None:
+            return
+        result.metrics["compile_s_charged"] = round(
+            sum(c["seconds"] for c in charges), 9)
+        if charges:
+            result.metrics["compile_charges"] = [
+                {"kind": c["kind"], "seconds": round(c["seconds"], 9),
+                 "recompile": c["recompile"]} for c in charges]
 
     def _plan_ir(self, graph: RelationalCypherGraph, ir,
                  plan_params, params: Dict[str, Any]):
@@ -554,9 +589,13 @@ class RelationalCypherSession(CypherSession):
                     sync_device=self.config.profile_sync_each_op):
                 with obs.activate(self.tracer):
                     with self.tracer.span("query", kind="query",
-                                          query=query, mode="profile"):
+                                          query=query, mode="profile"), \
+                            obs.compile_attributed(
+                                self.compile_ledger,
+                                normalize_query(query)) as charges:
                         result = self._cypher_on_graph(graph, query,
                                                        parameters)
+            self._stamp_compile_charges(result, charges)
         finally:
             self._profiling = prev_profiling
         if result.metrics is not None:
@@ -655,6 +694,12 @@ class RelationalCypherSession(CypherSession):
                 graph, ir, plan_params, params)
         checkpoint("plan")
         t4 = clock.now()
+        # Compile ledger (obs/compile.py): the cold plan phase is a
+        # compile boundary — a cache hit never pays it again, and a
+        # post-quarantine re-plan of the same (family, signature) shows
+        # up as a re-compile.
+        obs.compile_charge("plan", t4 - t0,
+                           shape=repr(param_signature(params)))
 
         plans = {"ir": ir.pretty(), "logical": logical.pretty(),
                  "relational": root.pretty()}
@@ -716,7 +761,9 @@ class RelationalCypherSession(CypherSession):
                 root=root, result_fields=logical.result_fields, plans=plans,
                 records_graph=rel_planner.current_graph, context=context,
                 spec_key=plan_params.spec_key(),
-                cold_phase_s=t4 - t0, nbytes=_plan_nbytes(plans, root),
+                cold_phase_s=t4 - t0,
+                nbytes=_plan_nbytes(plans, root, context=context,
+                                    catalog_deps=catalog_deps),
                 catalog_deps=tuple(sorted(catalog_deps.items())))
             # Drop the memoized results before parking the tree in the
             # cache: the records object holds the (header, table) refs,

@@ -437,6 +437,25 @@ class GraphSnapshot(RelationalCypherGraph):
             out = out.union_all(align_scan(header, dt))
         return header, out
 
+    # -- memory accounting (obs/ledger.py, serve/compaction.py) ---------
+
+    def delta_nbytes(self) -> int:
+        """Approximate bytes the delta overlay holds resident: the
+        appended delta tables plus the tombstone id sets — the input to
+        the byte-based compaction trigger
+        (``ServerConfig.compaction_threshold_bytes``) and the memory
+        ledger's per-snapshot delta accounting."""
+        n = 8 * (len(self.state.hidden_nodes)
+                 + len(self.state.hidden_rels))
+        if self.delta_graph is not None:
+            for et in (tuple(self.delta_graph.node_tables)
+                       + tuple(self.delta_graph.rel_tables)):
+                try:
+                    n += int(et.table.nbytes)
+                except Exception:  # pragma: no cover — must not fail
+                    pass
+        return n
+
     # -- replication (serve/devices.py) --------------------------------
 
     def rebase(self, session, base_copy: ScanGraph) -> "GraphSnapshot":
@@ -563,6 +582,10 @@ class VersionedGraph(RelationalCypherGraph):
 
     def delta_rows(self) -> int:
         return self._current.state.delta_rows
+
+    def delta_nbytes(self) -> int:
+        """Byte-side compaction backlog of the current snapshot."""
+        return self._current.delta_nbytes()
 
     @property
     def schema(self) -> Schema:

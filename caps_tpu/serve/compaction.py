@@ -40,14 +40,22 @@ STOPPED = "stopped"
 class Compactor:
     """Threshold-driven background compaction of one versioned graph."""
 
-    def __init__(self, graph, registry, threshold_rows: int = 512,
-                 interval_s: float = 0.05, on_failure=None):
+    def __init__(self, graph, registry, threshold_rows: Optional[int] = 512,
+                 interval_s: float = 0.05, on_failure=None,
+                 threshold_bytes: Optional[int] = None):
         if not getattr(graph, "graph_is_versioned", False):
             raise CompactionFailed(
                 f"compaction needs a versioned graph, got "
                 f"{type(graph).__name__}")
         self.graph = graph
-        self.threshold_rows = max(1, int(threshold_rows))
+        #: either trigger may be None (disabled); crossing EITHER live
+        #: threshold folds.  Bytes come from ``graph.delta_nbytes()``
+        #: (relational/updates.py) — a few huge property rows can now
+        #: trigger compaction long before the row count would.
+        self.threshold_rows = (max(1, int(threshold_rows))
+                               if threshold_rows is not None else None)
+        self.threshold_bytes = (max(1, int(threshold_bytes))
+                                if threshold_bytes is not None else None)
         self.interval_s = float(interval_s)
         #: optional incident hook called with the exception after every
         #: failed fold — the server wires the telemetry flight-recorder
@@ -79,9 +87,18 @@ class Compactor:
 
     # -- the loop ------------------------------------------------------
 
+    def _over_threshold(self) -> bool:
+        if self.threshold_rows is not None \
+                and self.graph.delta_rows() >= self.threshold_rows:
+            return True
+        if self.threshold_bytes is not None \
+                and self.graph.delta_nbytes() >= self.threshold_bytes:
+            return True
+        return False
+
     def _loop(self) -> None:
         while not self._stop.is_set():
-            if self.graph.delta_rows() >= self.threshold_rows:
+            if self._over_threshold():
                 self._state = RUNNING
                 try:
                     self.graph.compact()
@@ -120,6 +137,8 @@ class Compactor:
             "state": self._state,
             "backlog_rows": self.graph.delta_rows(),
             "threshold_rows": self.threshold_rows,
+            "backlog_bytes": self.graph.delta_nbytes(),
+            "threshold_bytes": self.threshold_bytes,
             "consecutive_failures": self._consecutive_failures,
             "last_error": self._last_error,
         }

@@ -58,6 +58,19 @@ and compaction failures (``server.dump_flight_recorder()`` on demand).
 / ``stats()["slo"]`` / ``stats()["batching"]`` the stats view, and
 ``server.metrics_text()`` the Prometheus text exposition of the whole
 registry (windowed gauges included).
+
+**Resource accounting** (ISSUE 10, obs/compile.py + obs/ledger.py +
+obs/log.py): every finished request carries a ``ledger`` dict on its
+handle (bytes in/out, compile seconds charged, peak rows) and in its
+flight record; the per-plan-family compile ledger surfaces in
+``stats()["compile"]`` / ``health_report()`` and drives
+``warmup_report()`` (which hot families never compiled here — ROADMAP
+item 2's AOT-warmup precondition); byte footprints (plan cache, string
+pool, base+delta per snapshot, device HBM) in ``stats()["memory"]``;
+and a structured event log (``server.events()``) plus a slow-query log
+(``ServerConfig.slow_query_threshold_s`` → ``server.slow_queries()``,
+records mergeable with flight dumps) correlate it all by request id /
+plan family / snapshot version.
 """
 from __future__ import annotations
 
@@ -68,6 +81,7 @@ from typing import Any, Dict, List, Mapping, Optional
 
 from caps_tpu.obs import clock
 from caps_tpu.obs.lockgraph import make_lock
+from caps_tpu.obs.log import EventLog, SlowQueryLog
 from caps_tpu.obs.telemetry import ServingTelemetry, SLOConfig
 from caps_tpu.serve import batcher as _batcher
 from caps_tpu.serve.admission import AdmissionController
@@ -171,10 +185,29 @@ class ServerConfig:
     device_cooldown_s: float = 1.0
     #: delta-store backlog (rows) that triggers background compaction of
     #: a versioned default graph (serve/compaction.py); None disables
-    #: the compactor (explicit ``graph.compact()`` still works)
+    #: the row trigger (explicit ``graph.compact()`` still works)
     compaction_threshold_rows: Optional[int] = None
+    #: delta-store backlog (bytes — ``graph.delta_nbytes()``) that
+    #: triggers background compaction; crossing EITHER threshold folds.
+    #: A few huge property rows can now trigger compaction long before
+    #: the row count would.
+    compaction_threshold_bytes: Optional[int] = None
     #: cadence of the compactor's backlog checks
     compaction_interval_s: float = 0.05
+    #: structured slow-query log (obs/log.py): any request whose total
+    #: latency crosses this captures a full record — plan text, per-op
+    #: stats, ledger (bytes in/out, compile seconds, peak rows) — in
+    #: ``server.slow_queries()``; None disables capture
+    slow_query_threshold_s: Optional[float] = None
+    #: bounded ring size of captured slow-query records
+    slow_query_log_size: int = 64
+    #: bounded ring size of the structured event log (compile charges,
+    #: breaker trips, quarantines, compaction failures, slow queries —
+    #: ``server.events()``)
+    event_log_capacity: int = 1024
+    #: optional JSON-lines sink: every structured event also appends to
+    #: this file (off-process ingestion)
+    event_log_path: Optional[str] = None
     #: serving SLO (obs/telemetry.py): a latency target + objectives
     #: evaluated over the telemetry window into error-budget burn rates
     #: (``health_report()``, ``slo.*`` gauges); None = no SLO evaluation
@@ -215,6 +248,27 @@ class QueryServer:
             registry, window_s=self.config.telemetry_window_s,
             buckets=self.config.telemetry_buckets, slo=self.config.slo,
             flight_recorder_size=self.config.flight_recorder_size)
+        #: structured event log (obs/log.py): compile charges, breaker
+        #: trips, quarantines, compaction failures, slow queries — every
+        #: event correlated by request id / plan family
+        self.event_log = EventLog(capacity=self.config.event_log_capacity,
+                                  registry=registry,
+                                  path=self.config.event_log_path)
+        #: slow-query log: over-threshold requests captured with plan
+        #: text, per-op stats, and the resource ledger (None = disabled)
+        self.slow_log = None
+        if self.config.slow_query_threshold_s is not None:
+            self.slow_log = SlowQueryLog(
+                self.config.slow_query_threshold_s,
+                capacity=self.config.slow_query_log_size,
+                registry=registry, event_log=self.event_log)
+        #: memory ledger (obs/ledger.py): account the served graph so
+        #: ``stats()["memory"]`` carries its base/delta footprint.
+        #: The "default" slot is last-writer-wins across servers on one
+        #: session; shutdown releases it only if still ours.
+        ledger = getattr(session, "memory_ledger", None)
+        if ledger is not None:
+            ledger.track("default", self._default_graph)
         self.admission = AdmissionController(
             registry, max_queue=self.config.max_queue,
             per_priority_limits=self.config.per_priority_limits,
@@ -263,16 +317,17 @@ class QueryServer:
         #: (serve/compaction.py) — None unless configured AND the graph
         #: is versioned
         self.compactor = None
-        if (self.config.compaction_threshold_rows is not None
+        if ((self.config.compaction_threshold_rows is not None
+             or self.config.compaction_threshold_bytes is not None)
                 and getattr(self._default_graph, "graph_is_versioned",
                             False)):
             from caps_tpu.serve.compaction import Compactor
             self.compactor = Compactor(
                 self._default_graph, registry,
                 threshold_rows=self.config.compaction_threshold_rows,
+                threshold_bytes=self.config.compaction_threshold_bytes,
                 interval_s=self.config.compaction_interval_s,
-                on_failure=lambda ex: self.telemetry.auto_dump(
-                    "compaction_failure"))
+                on_failure=self._compaction_failed)
         if start:
             self.start()
 
@@ -328,7 +383,7 @@ class QueryServer:
             # exit once the (closed) queue is empty
             self.start()
         if not self._started:
-            self.telemetry.close()
+            self._release_resources()
             return True
         deadline = None if timeout is None else clock.now() + timeout
         for t in self._threads:
@@ -342,8 +397,19 @@ class QueryServer:
             # fully stopped: the windowed gauges must not keep reading
             # (or pinning) this server's telemetry — same contract as
             # the admission depth gauge's deregistration
-            self.telemetry.close()
+            self._release_resources()
         return not still_running
+
+    def _release_resources(self) -> None:
+        """Full-stop cleanup: telemetry gauges leave the live set, the
+        event-log file sink closes, and the memory ledger drops this
+        server's graph slot (only if a newer server has not re-tracked
+        it) so a dead server stops inflating ``mem.tracked_graph_bytes``."""
+        self.telemetry.close()
+        self.event_log.close()
+        ledger = getattr(self.session, "memory_ledger", None)
+        if ledger is not None:
+            ledger.untrack_if("default", self._default_graph)
 
     def __enter__(self) -> "QueryServer":
         return self.start()
@@ -403,8 +469,10 @@ class QueryServer:
         breaker states), the per-device fault-domain view
         (``devices``: health, request counts, quarantine/reinstate
         transition counters per replica), the windowed telemetry and SLO
-        views (``telemetry`` / ``slo``), and micro-batch occupancy
-        (``batching``)."""
+        views (``telemetry`` / ``slo``), micro-batch occupancy
+        (``batching``), the per-family compile ledger (``compile``),
+        byte footprints (``memory``), and the slow-query count
+        (``slow_queries``)."""
         snap = self._registry.snapshot()
         out = {k[len("serve."):]: v for k, v in snap.items()
                if k.startswith("serve.")}
@@ -416,7 +484,19 @@ class QueryServer:
         out["telemetry"] = self.telemetry.summary()
         out["slo"] = self.telemetry.slo_report()
         out["batching"] = self._batching_stats(snap)
+        out["compile"] = self._compile_summary()
+        out["memory"] = self._memory_report()
+        out["slow_queries"] = (len(self.slow_log.records())
+                               if self.slow_log is not None else None)
         return out
+
+    def _compile_summary(self) -> Optional[Dict[str, Any]]:
+        ledger = getattr(self.session, "compile_ledger", None)
+        return ledger.summary() if ledger is not None else None
+
+    def _memory_report(self) -> Optional[Dict[str, Any]]:
+        ledger = getattr(self.session, "memory_ledger", None)
+        return ledger.report() if ledger is not None else None
 
     def _batching_stats(self, snap: Dict[str, Any]) -> Dict[str, Any]:
         """Micro-batch occupancy (ROADMAP item 2's missing number):
@@ -452,7 +532,51 @@ class QueryServer:
             "devices": self.devices.summary(),
             "compaction": (self.compactor.summary()
                            if self.compactor is not None else None),
+            # the resource-accounting sections (ISSUE 10): per-family
+            # compile ledger, byte footprints, and the observed-stats
+            # rollup (the item-4 re-plan signal) — visible without
+            # scraping the registry
+            "compile": self._compile_summary(),
+            "memory": self._memory_report(),
+            "opstats": self.session.op_stats.summary(),
         }
+
+    def warmup_report(self, families: Optional[List[str]] = None
+                      ) -> Dict[str, Any]:
+        """Warmup coverage: which hot plan families have NEVER compiled
+        on this process — the direct precondition for ROADMAP item 2's
+        AOT warmup (warm exactly the cold ones at server start).
+
+        ``families`` defaults to the families the observed-statistics
+        store has seen execute (``session.op_stats``); pass an explicit
+        list (e.g. the hot families from a previous process's dump) to
+        plan a cold start.  A family counts as compiled when the compile
+        ledger holds ANY charge for it (cold plan phase included), so on
+        a warmed server ``cold_families`` is empty."""
+        ledger = getattr(self.session, "compile_ledger", None)
+        hot = (list(families) if families is not None
+               else self.session.op_stats.families())
+        compiled = set(ledger.families()) if ledger is not None else set()
+        cold = [f for f in hot if f not in compiled]
+        return {
+            "hot_families": len(hot),
+            "compiled_hot_families": len(hot) - len(cold),
+            "cold_families": cold,
+            "compile_s_by_family": {
+                f[:120]: round(ledger.seconds_for(f), 6)
+                for f in hot if f in compiled} if ledger is not None
+            else {},
+        }
+
+    def events(self, event: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Snapshot of the structured event log (obs/log.py), optionally
+        filtered by event name."""
+        return self.event_log.records(event)
+
+    def slow_queries(self) -> List[Dict[str, Any]]:
+        """Captured slow-query records (empty when
+        ``slow_query_threshold_s`` is unset)."""
+        return self.slow_log.records() if self.slow_log is not None else []
 
     def metrics_text(self) -> str:
         """Prometheus text-exposition of the session registry — the
@@ -662,6 +786,10 @@ class QueryServer:
                     # the probe (and its fast-failed siblings) are in the
                     # ring by now: the dump carries their attempt history
                     self.telemetry.auto_dump("breaker_trip")
+                    self.event_log.emit(
+                        "breaker.trip", request_id=probe.request_id,
+                        family=self._family_label(probe),
+                        trigger="failed_half_open_trial")
                     return
                 self.breaker.record_success(family)
                 self._finish(probe, outcome)
@@ -745,6 +873,10 @@ class QueryServer:
                 # AFTER the finish: the tripping request is in the
                 # flight ring, so the dump carries its attempt history
                 self.telemetry.auto_dump("breaker_trip")
+                self.event_log.emit(
+                    "breaker.trip", request_id=req.request_id,
+                    family=self._family_label(req),
+                    trigger="failure_threshold")
 
     def _note_device_outcomes(self, replica: DeviceReplica,
                               outcomes: List[Any]) -> None:
@@ -760,6 +892,10 @@ class QueryServer:
                     # this failure quarantined the device: black-box the
                     # in-flight picture for the postmortem
                     self.telemetry.auto_dump("device_quarantine")
+                    self.event_log.emit(
+                        "device.quarantine", request_id=None, family=None,
+                        device=replica.index,
+                        error=type(outcome).__name__)
             else:
                 self.devices.record_success(replica)
 
@@ -929,6 +1065,9 @@ class QueryServer:
         if tracer.enabled:
             tracer.event("plan.quarantined", query=req.query,
                          device=replica.index)
+        self.event_log.emit(
+            "plan.quarantine", request_id=req.request_id,
+            family=self._family_label(req), device=replica.index)
 
     def _finish(self, req: Request, outcome: Any) -> None:
         """Materialize (deadline-checked) and complete one handle."""
@@ -949,11 +1088,59 @@ class QueryServer:
             self._flight(req, ex)
             req.handle._complete(exception=ex)
             return
+        self._note_ledger(req, outcome)
         req.handle.info["latency_s"] = req.scope.elapsed()
         self._latency.observe(req.handle.info["latency_s"])
         self._completed.inc()
-        self._flight(req, None)
+        self._flight(req, None, outcome)
         req.handle._complete(result=outcome, rows=rows)
+
+    def _note_ledger(self, req: Request, result: Any) -> None:
+        """The per-request resource ledger (ISSUE 10): bytes pulled
+        through memory, result bytes out, compile seconds charged to
+        this execution (obs/compile.py via the session's per-query
+        stamp), and peak operator cardinality — stamped on the handle
+        and carried by the flight-recorder and slow-query records.
+        Compile charges also land in the telemetry window and the
+        structured event log."""
+        m = getattr(result, "metrics", None) or {}
+        compile_s = float(m.get("compile_s_charged") or 0.0)
+        peak = 0
+        for entry in m.get("operators") or ():
+            r = entry.get("rows") or 0
+            if r > peak:
+                peak = r
+        if not peak:
+            peak = int(m.get("rows") or 0)
+        bytes_out = 0
+        records = getattr(result, "records", None)
+        if records is not None:
+            try:
+                bytes_out = int(records.table.nbytes)
+            except Exception:  # pragma: no cover — accounting only
+                bytes_out = 0
+        req.handle.info["ledger"] = {
+            "bytes_in": int(m.get("bytes_touched") or 0),
+            "bytes_out": bytes_out,
+            "compile_s": round(compile_s, 9),
+            "peak_rows": int(peak),
+        }
+        if compile_s > 0.0:
+            self.telemetry.note_compile(compile_s)
+            self.event_log.emit(
+                "compile.charged", request_id=req.request_id,
+                family=self._family_label(req),
+                seconds=round(compile_s, 6),
+                snapshot_version=req.handle.info.get("snapshot_version"))
+
+    def _compaction_failed(self, ex: BaseException) -> None:
+        """Compaction-failure incident hook (serve/compaction.py): flight
+        dump plus a structured event (no request to correlate — the
+        fields are explicit Nones, never absent)."""
+        self.telemetry.auto_dump("compaction_failure")
+        self.event_log.emit(
+            "compaction.failure", request_id=None, family=None,
+            error=f"{type(ex).__name__}: {str(ex)[:200]}")
 
     def _family_label(self, req: Request) -> str:
         """Human-meaningful plan-family label for telemetry and the
@@ -964,12 +1151,16 @@ class QueryServer:
             return str(req.batch_key[1])[:120]
         return f"{req.mode or 'solo'}:{req.query[:100]}"
 
-    def _flight(self, req: Request, exc: Optional[BaseException]) -> None:
+    def _flight(self, req: Request, exc: Optional[BaseException],
+                result: Any = None) -> None:
         """One finished request's black-box record + windowed outcome
         note.  Cancellation AND deadline expiry count as aborts
         (excluded from availability — the budget's verdict, not the
         server's, same exemption the breaker and device ladder apply);
-        every other failure counts against availability."""
+        every other failure counts against availability.  Every record
+        carries the request's resource ledger; over-threshold requests
+        additionally capture plan text + per-op stats in the slow-query
+        log (same record shape, so dumps and slow entries merge)."""
         info = req.handle.info
         latency_s = req.scope.elapsed()
         family = self._family_label(req)
@@ -990,6 +1181,9 @@ class QueryServer:
             "latency_s": round(latency_s, 6),
             "phase": req.scope.phase,
             "outcome": "ok" if exc is None else type(exc).__name__,
+            "ledger": info.get("ledger", {"bytes_in": 0, "bytes_out": 0,
+                                          "compile_s": 0.0,
+                                          "peak_rows": 0}),
         }
         if info.get("snapshot_version") is not None:
             rec["snapshot_version"] = info["snapshot_version"]
@@ -1000,6 +1194,15 @@ class QueryServer:
         if info.get("quarantined"):
             rec["quarantined"] = True
         self.telemetry.recorder.record(rec)
+        if self.slow_log is not None:
+            plan = operators = None
+            if result is not None:
+                plans = getattr(result, "plans", None) or {}
+                plan = plans.get("relational") or plans.get("ir")
+                m = getattr(result, "metrics", None) or {}
+                operators = [dict(e)
+                             for e in (m.get("operators") or ())][:64]
+            self.slow_log.consider(rec, plan=plan, operators=operators)
 
     def _count_failure(self, ex: BaseException) -> None:
         if isinstance(ex, DeadlineExceeded):

@@ -533,6 +533,77 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
         assert name in listed
 
 
+# -- structured-log ----------------------------------------------------------
+
+LOG_MODULE = """\
+class EventLog:
+    def emit(self, event, *, request_id, family, **fields):
+        return {"event": event, "request_id": request_id,
+                "family": family, **fields}
+"""
+
+
+def test_structured_log_missing_field_fires(tmp_path):
+    caller = """\
+def trip(log, req):
+    log.emit("breaker.trip", request_id=req.request_id)
+"""
+    p = _project(tmp_path, {"caps_tpu/obs/log.py": LOG_MODULE,
+                            "caps_tpu/serve/caller.py": caller})
+    found = _findings(p, "structured-log")
+    assert len(found) == 1
+    f = found[0]
+    assert f.path == "caps_tpu/serve/caller.py" and f.line == 2
+    assert "family" in f.message and "request_id" not in f.message.split(
+        "field(s) ")[1].split(" —")[0]
+
+
+def test_structured_log_explicit_none_and_splat_pass(tmp_path):
+    caller = """\
+def ok(log, extra):
+    log.emit("compaction.failure", request_id=None, family=None)
+    log.emit("odd", **extra)  # splat: present-ness unverifiable
+"""
+    p = _project(tmp_path, {"caps_tpu/obs/log.py": LOG_MODULE,
+                            "caps_tpu/serve/caller.py": caller})
+    assert _findings(p, "structured-log") == []
+
+
+def test_structured_log_missing_module_is_a_finding(tmp_path):
+    p = _project(tmp_path, {"caps_tpu/serve/caller.py": "x = 1\n"})
+    found = _findings(p, "structured-log")
+    assert len(found) == 1
+    assert found[0].path == "caps_tpu/obs/log.py"
+    assert "missing" in found[0].message
+
+
+def test_structured_log_module_without_anchor_is_a_finding(tmp_path):
+    p = _project(tmp_path, {"caps_tpu/obs/log.py": "def emit(x):\n"
+                                                   "    return x\n"})
+    found = _findings(p, "structured-log")
+    assert len(found) == 1 and "no anchor" in found[0].message
+
+
+def test_structured_log_bare_emit_call_checked(tmp_path):
+    log_mod = LOG_MODULE + """\
+
+
+def emit(event, *, request_id, family):
+    return (event, request_id, family)
+"""
+    caller = """\
+from caps_tpu.obs.log import emit
+
+
+def fire():
+    emit("loose")
+"""
+    p = _project(tmp_path, {"caps_tpu/obs/log.py": log_mod,
+                            "caps_tpu/serve/caller.py": caller})
+    found = _findings(p, "structured-log")
+    assert len(found) == 1 and found[0].line == 5
+
+
 # -- the live repo -----------------------------------------------------------
 
 def test_live_repo_is_clean():
@@ -541,7 +612,7 @@ def test_live_repo_is_clean():
     assert findings == [], "\n".join(f.format() for f in findings)
     assert set(pass_names()) == {"lock-order", "tracer-purity",
                                  "error-taxonomy", "clock-discipline",
-                                 "metric-names"}
+                                 "metric-names", "structured-log"}
 
 
 def test_live_repo_static_lock_graph_has_serve_edges():

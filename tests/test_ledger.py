@@ -1,0 +1,509 @@
+"""Compile & memory observability (ISSUE 10): the per-query resource
+ledger, compile telemetry, and the structured slow-query log.
+
+* compile ledger: cold plan phases, fused record runs, and program-cache
+  misses charge per plan family with first-seen-vs-re-compile semantics;
+  a plan-cache hit / fused replay charges ZERO compile seconds, and a
+  post-quarantine re-record shows up as a re-compile for its family;
+* memory ledger: ``mem.*`` gauges over the plan cache, string pool,
+  tracked graphs (base/delta split per snapshot version), and device
+  allocator stats (graceful CPU fallback);
+* byte-based compaction: ``compaction_threshold_bytes`` folds a
+  versioned graph whose delta grew heavy before the row count would;
+* structured logs: the bounded event ring (JSON-lines sink, correlation
+  by request id / family) and the slow-query log whose records share
+  the flight recorder's shape.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import caps_tpu
+from caps_tpu.obs import clock
+from caps_tpu.obs.compile import (CompileLedger, attributed, charge,
+                                  charged, global_compile_ledger)
+from caps_tpu.obs.ledger import (MemoryLedger, device_memory,
+                                 snapshot_footprint)
+from caps_tpu.obs.log import EventLog, SlowQueryLog
+from caps_tpu.obs.metrics import MetricsRegistry
+from caps_tpu.relational.updates import versioned
+from caps_tpu.serve import QueryServer, ServerConfig
+from caps_tpu.testing.factory import create_graph
+
+SOCIAL = """
+    CREATE (a:Person {name: 'Alice', age: 33}),
+           (b:Person {name: 'Bob', age: 44}),
+           (c:Person {name: 'Carol', age: 27}),
+           (a)-[:KNOWS {since: 2011}]->(b),
+           (b)-[:KNOWS {since: 2015}]->(c)
+"""
+
+Q_AGE = ("MATCH (p:Person) WHERE p.age > $min "
+         "RETURN p.name AS n ORDER BY n")
+
+
+def _session(backend="tpu"):
+    return caps_tpu.local_session(backend=backend)
+
+
+# -- compile ledger (unit) ---------------------------------------------------
+
+def test_compile_ledger_first_seen_vs_recompile():
+    reg = MetricsRegistry()
+    led = CompileLedger(registry=reg)
+    c1 = led.charge("famA", "plan", 0.5, shape="sig1")
+    assert c1["first_seen"] and not c1["recompile"]
+    # a different shape of the same family is NOT a re-compile
+    c2 = led.charge("famA", "plan", 0.25, shape="sig2")
+    assert not c2["recompile"]
+    # the same (kind, shape) again IS
+    c3 = led.charge("famA", "plan", 0.25, shape="sig1")
+    assert c3["recompile"] and not c3["first_seen"]
+    st = led.stats("famA")
+    assert st["compiles"] == 3 and st["recompiles"] == 1
+    assert st["total_s"] == pytest.approx(1.0)
+    assert st["by_kind"]["plan"]["count"] == 3
+    snap = reg.snapshot()
+    assert snap["compile.events"] == 3
+    assert snap["compile.recompiles"] == 1
+    assert snap["compile.seconds"] == pytest.approx(1.0)
+    assert snap["compile.families"] == 1
+    summary = led.summary()
+    assert summary["families"] == 1 and summary["events"] == 3
+    assert "famA" in summary["by_family"]
+
+
+def test_compile_ledger_lru_bound():
+    led = CompileLedger(max_families=3)
+    for i in range(5):
+        led.charge(f"f{i}", "plan", 0.01)
+    assert led.family_count() == 3
+    assert led.families() == ["f2", "f3", "f4"]
+    # touching an old survivor keeps it live past the next insert
+    led.charge("f2", "plan", 0.01)
+    led.charge("f9", "plan", 0.01)
+    assert "f2" in led.families() and "f3" not in led.families()
+
+
+def test_attributed_scope_collects_and_nests():
+    led = CompileLedger()
+    with attributed(led, "outer") as charges:
+        charge("plan", 0.5)
+        # a nested scope (subquery) re-attributes the family but shares
+        # the OUTER charge list — request totals include subqueries
+        with attributed(led, "inner"):
+            charge("count_fused", 0.25)
+    assert [c["family"] for c in charges] == ["outer", "inner"]
+    assert sum(c["seconds"] for c in charges) == pytest.approx(0.75)
+    assert led.seconds_for("outer") == pytest.approx(0.5)
+
+
+def test_unattributed_charge_lands_in_global_ledger():
+    g = global_compile_ledger()
+    before = g.seconds_for("(unattributed)")
+    charge("dist_join", 0.125)
+    assert g.seconds_for("(unattributed)") - before == pytest.approx(0.125)
+
+
+def test_charged_context_times_the_region():
+    led = CompileLedger()
+    with attributed(led, "f") as charges:
+        with charged("count_fused", shape="s"):
+            pass
+    assert len(charges) == 1 and charges[0]["kind"] == "count_fused"
+    assert charges[0]["seconds"] >= 0.0
+
+
+# -- session integration: cold charges, warm zeros, quarantine re-compiles --
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_cold_plan_charges_and_cache_hit_charges_zero(backend):
+    s = _session(backend)
+    g = create_graph(s, SOCIAL)
+    r1 = s.cypher_on_graph(g, Q_AGE, {"min": 30})
+    assert r1.metrics["compile_s_charged"] > 0.0
+    kinds = {c["kind"] for c in r1.metrics["compile_charges"]}
+    assert "plan" in kinds
+    if backend == "tpu":
+        assert "fused_record" in kinds
+    # warm path: same family, new binding — plan-cache hit (and fused
+    # replay on the TPU backend) must charge ZERO compile seconds
+    r2 = s.cypher_on_graph(g, Q_AGE, {"min": 40})
+    assert r2.metrics["plan_cache"] == "hit"
+    assert r2.metrics["compile_s_charged"] == 0.0
+    assert "compile_charges" not in r2.metrics
+    assert len(s.compile_ledger.families()) == 1
+
+
+def test_fused_replay_zero_charge_and_quarantine_rerecord_is_recompile():
+    """The satellite regression: a replayed (cache-hit) execution
+    charges nothing; after the serving tier's quarantine path (plan
+    cache entry + fused memos evicted) the re-execution re-records and
+    the ledger counts a re-compile for that family."""
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    params = {"min": 30}
+    r1 = s.cypher_on_graph(g, Q_AGE, params)
+    assert any(c["kind"] == "fused_record"
+               for c in r1.metrics["compile_charges"])
+    replays0 = s.fused.replays
+    r2 = s.cypher_on_graph(g, Q_AGE, params)
+    assert s.fused.replays == replays0 + 1  # replayed, not re-recorded
+    assert r2.metrics["compile_s_charged"] == 0.0
+    family = s.compile_ledger.families()[0]
+    assert s.compile_ledger.stats(family)["recompiles"] == 0
+    # quarantine exactly what serve/server.py _quarantine evicts
+    key = s._plan_cache_key(g, Q_AGE, params)
+    assert s.plan_cache.quarantine(key) >= 1
+    assert s.fused.forget(g, Q_AGE) >= 1
+    r3 = s.cypher_on_graph(g, Q_AGE, params)
+    assert r3.metrics["compile_s_charged"] > 0.0
+    charges = {c["kind"]: c for c in r3.metrics["compile_charges"]}
+    # same family, same shapes → every charge is a re-compile
+    assert charges["plan"]["recompile"]
+    assert charges["fused_record"]["recompile"]
+    assert s.compile_ledger.stats(family)["recompiles"] >= 2
+
+
+# -- server surfaces: stats()/health_report()/warmup_report()/telemetry -----
+
+def test_server_compile_surfaces_and_warmup_report():
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    server = QueryServer(s, graph=g)
+    try:
+        assert server.run(Q_AGE, {"min": 30}).to_maps() == [
+            {"n": "Alice"}, {"n": "Bob"}]
+        st = server.stats()
+        assert st["compile"]["families"] >= 1
+        assert st["compile"]["total_s"] > 0.0
+        report = server.health_report()
+        assert report["compile"]["families"] >= 1
+        # the opstats satellite: the item-4 re-plan signal without
+        # scraping the registry
+        ops = report["opstats"]
+        assert ops["families"] >= 1 and ops["recorded"] >= 1
+        assert "divergences" in ops
+        # windowed compile seconds: the cold charge landed in-window
+        assert report["window"]["compile"]["events"] >= 1
+        assert report["window"]["compile"]["seconds"] > 0.0
+        # warmed: every hot family compiled on this process
+        warm = server.warmup_report()
+        assert warm["hot_families"] >= 1
+        assert warm["cold_families"] == []
+        assert any(v > 0.0 for v in warm["compile_s_by_family"].values())
+        # a cold start planned from an external hot-family list
+        cold = server.warmup_report(families=["never-seen-family"])
+        assert cold["cold_families"] == ["never-seen-family"]
+        assert cold["compiled_hot_families"] == 0
+    finally:
+        server.shutdown()
+
+
+def test_expose_text_carries_compile_and_mem_samples():
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    server = QueryServer(s, graph=g)
+    try:
+        server.run(Q_AGE, {"min": 30})
+        text = server.metrics_text()
+    finally:
+        server.shutdown()
+    assert "\ncompile_seconds " in text or \
+        text.startswith("compile_seconds ")
+    for name in ("compile_events", "compile_families",
+                 "mem_plan_cache_bytes", "mem_string_pool_bytes",
+                 "mem_device_bytes_in_use", "telemetry_compile_s"):
+        assert f"\n{name} " in text, name
+
+
+# -- memory ledger -----------------------------------------------------------
+
+def test_memory_ledger_gauges_and_report():
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    s.cypher_on_graph(g, Q_AGE, {"min": 30})  # cache a plan, intern strings
+    snap = s.metrics_snapshot()
+    assert snap["mem.plan_cache_bytes"] > 0
+    assert snap["mem.string_pool_bytes"] > 0
+    assert snap["mem.plan_cache_bytes"] == s.plan_cache.stats()["bytes"]
+    s.memory_ledger.track("g", g)
+    rep = s.memory_ledger.report()
+    assert rep["graphs"]["g"]["bytes"] > 0
+    assert rep["tracked_graph_bytes"] == rep["graphs"]["g"]["bytes"]
+    assert isinstance(rep["devices"], dict) and rep["devices"]
+    # CPU fallback is honest: every device entry says whether it can
+    # measure; the rollup only sums the ones that can
+    for entry in rep["devices"].values():
+        assert "available" in entry
+    s.memory_ledger.untrack("g")
+    assert s.memory_ledger.report()["graphs"] == {}
+
+
+def test_device_memory_graceful_fallback():
+    mem = device_memory()
+    assert isinstance(mem, dict)
+    for entry in mem.values():
+        if not entry["available"]:
+            assert "bytes_in_use" not in entry
+
+
+def test_snapshot_footprint_versioned_base_delta_split():
+    s = _session("tpu")
+    vg = versioned(s, create_graph(s, SOCIAL))
+    base = snapshot_footprint(vg)
+    assert base["base_bytes"] > 0 and base["delta_bytes"] == 0
+    assert base["snapshot_version"] == 0
+    vg.cypher("CREATE (:Person {name:'Dave', age:52})")
+    vg.cypher("MATCH (p:Person {name:'Carol'}) DETACH DELETE p")
+    after = snapshot_footprint(vg)
+    assert after["snapshot_version"] == 2
+    assert after["delta_rows"] == vg.delta_rows() > 0
+    assert after["delta_bytes"] > 0
+    assert after["bytes"] == after["base_bytes"] + after["delta_bytes"]
+    assert vg.delta_nbytes() == after["delta_bytes"]
+
+
+def test_server_tracks_default_graph_in_memory_report():
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    server = QueryServer(s, graph=g, start=False)
+    try:
+        mem = server.stats()["memory"]
+        assert mem["graphs"]["default"]["bytes"] > 0
+        assert mem["plan_cache_bytes"] >= 0
+    finally:
+        server.shutdown()
+
+
+# -- byte-based compaction ---------------------------------------------------
+
+def test_compaction_threshold_bytes_triggers_fold():
+    s = _session("tpu")
+    vg = versioned(s, create_graph(s, SOCIAL))
+    vg.cypher("CREATE (:Person {name:'Dave', age:52})")
+    backlog = vg.delta_nbytes()
+    assert backlog > 0
+    server = QueryServer(s, graph=vg, config=ServerConfig(
+        compaction_threshold_rows=None,
+        compaction_threshold_bytes=max(1, backlog // 2),
+        compaction_interval_s=0.005))
+    try:
+        assert server.compactor is not None
+        assert server.compactor.threshold_rows is None
+        deadline = clock.now() + 10.0
+        while vg.delta_rows() > 0 and clock.now() < deadline:
+            clock.sleep(0.01)
+        assert vg.delta_rows() == 0, "byte-threshold compaction never ran"
+        summary = server.stats()["compaction"]
+        assert summary["threshold_bytes"] == max(1, backlog // 2)
+        assert summary["backlog_bytes"] == 0
+    finally:
+        server.shutdown()
+
+
+# -- structured event log ----------------------------------------------------
+
+def test_event_log_ring_bound_filter_and_correlation():
+    log = EventLog(capacity=4)
+    for i in range(6):
+        log.emit("tick", request_id=i, family=f"f{i % 2}")
+    recs = log.records()
+    assert len(recs) == 4  # bounded: oldest evicted
+    assert [r["request_id"] for r in recs] == [2, 3, 4, 5]
+    assert all({"event", "t", "wall", "request_id", "family"} <= set(r)
+               for r in recs)
+    assert [r["request_id"] for r in log.records("tick")] == [2, 3, 4, 5]
+    assert log.records("nope") == []
+    assert [r["family"] for r in log.for_request(4)] == ["f0"]
+    assert log.emitted == 6
+
+
+def test_event_log_jsonl_sinks(tmp_path):
+    live = tmp_path / "live.jsonl"
+    log = EventLog(capacity=8, path=str(live))
+    log.emit("a", request_id=1, family="f", payload={"x": 1})
+    log.emit("b", request_id=None, family=None, odd=object())
+    log.close()
+    lines = [json.loads(ln) for ln in
+             live.read_text().strip().splitlines()]
+    assert [ln["event"] for ln in lines] == ["a", "b"]
+    assert lines[0]["payload"] == {"x": 1}
+    assert isinstance(lines[1]["odd"], str)  # non-JSON values repr()'d
+    dumped = tmp_path / "dump.jsonl"
+    log.write(str(dumped))
+    assert len(dumped.read_text().strip().splitlines()) == 2
+
+
+def test_slow_query_log_threshold_and_event():
+    events = EventLog(capacity=8)
+    reg = MetricsRegistry()
+    slow = SlowQueryLog(0.5, capacity=2, registry=reg, event_log=events)
+    fast = {"request_id": 1, "family": "f", "latency_s": 0.1,
+            "outcome": "ok"}
+    assert slow.consider(fast) is False
+    rec = {"request_id": 2, "family": "f", "latency_s": 0.9,
+           "outcome": "ok"}
+    assert slow.consider(rec, plan="Scan", operators=[{"op": "Scan"}])
+    got = slow.records()[0]
+    assert got["plan"] == "Scan" and got["slow_threshold_s"] == 0.5
+    assert reg.snapshot()["slowlog.captured"] == 1
+    assert [e["event"] for e in events.records()] == ["slow_query"]
+    assert events.records()[0]["request_id"] == 2
+
+
+# -- the slow-query log through the server -----------------------------------
+
+def test_server_slow_query_capture_with_ledger():
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    server = QueryServer(s, graph=g, config=ServerConfig(
+        slow_query_threshold_s=0.0))  # everything is "slow"
+    try:
+        h = server.submit(Q_AGE, {"min": 30})
+        assert h.rows() == [{"n": "Alice"}, {"n": "Bob"}]
+        # the per-request resource ledger on the handle
+        ledger = h.info["ledger"]
+        assert ledger["bytes_in"] > 0
+        assert ledger["bytes_out"] > 0
+        assert ledger["compile_s"] > 0.0  # cold execution compiled
+        assert ledger["peak_rows"] >= 2
+        slow = server.slow_queries()
+        assert len(slow) == 1
+        rec = slow[0]
+        # the acceptance assertion: captured ledger fields are non-empty
+        assert rec["ledger"] == ledger
+        assert rec["plan"]  # relational plan text
+        assert rec["operators"] and all("op" in e for e in rec["operators"])
+        # mergeable with flight dumps: the slow record is a strict
+        # superset of the flight recorder's record for the same request
+        flight = [r for r in server.telemetry.recorder.snapshot()
+                  if r["request_id"] == rec["request_id"]][0]
+        assert set(flight) <= set(rec)
+        assert flight["ledger"] == rec["ledger"]
+        # correlated events: compile charge + slow capture for this id
+        kinds = {e["event"] for e in server.event_log.for_request(
+            rec["request_id"])}
+        assert {"compile.charged", "slow_query"} <= kinds
+        assert server.stats()["slow_queries"] == 1
+    finally:
+        server.shutdown()
+
+
+def test_server_slow_log_disabled_and_high_threshold():
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    server = QueryServer(s, graph=g)  # no threshold: disabled
+    try:
+        server.run(Q_AGE, {"min": 30})
+        assert server.slow_queries() == []
+        assert server.stats()["slow_queries"] is None
+    finally:
+        server.shutdown()
+    server = QueryServer(s, graph=g, config=ServerConfig(
+        slow_query_threshold_s=3600.0))
+    try:
+        server.run(Q_AGE, {"min": 40})
+        assert server.slow_queries() == []  # nothing that slow
+        assert server.stats()["slow_queries"] == 0
+    finally:
+        server.shutdown()
+
+
+def test_flight_records_always_carry_a_ledger():
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    server = QueryServer(s, graph=g)
+    try:
+        server.run(Q_AGE, {"min": 30})
+        with pytest.raises(Exception):
+            server.run("MATCH (p:Person) RETURN boom(p.name) AS x")
+        recs = server.telemetry.recorder.snapshot()
+        assert len(recs) == 2
+        for rec in recs:
+            assert {"bytes_in", "bytes_out", "compile_s",
+                    "peak_rows"} <= set(rec["ledger"])
+        ok = [r for r in recs if r["outcome"] == "ok"][0]
+        assert ok["ledger"]["bytes_in"] > 0
+    finally:
+        server.shutdown()
+
+
+# -- review regressions ------------------------------------------------------
+
+def test_event_log_sink_failure_never_fails_emit(tmp_path):
+    log = EventLog(capacity=4, path=str(tmp_path / "no-such-dir" / "e.jsonl"))
+    rec = log.emit("tick", request_id=1, family="f")  # must not raise
+    assert rec["event"] == "tick"
+    assert log.sink_failed is True
+    assert len(log.records()) == 1  # ring logging survives a dead sink
+    log.emit("tock", request_id=2, family="f")
+    assert len(log.records()) == 2
+
+
+def test_server_survives_misconfigured_event_log_path(tmp_path):
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    server = QueryServer(s, graph=g, config=ServerConfig(
+        slow_query_threshold_s=0.0,
+        event_log_path=str(tmp_path / "missing" / "events.jsonl")))
+    try:
+        # the finish path emits compile.charged + slow_query: a broken
+        # sink must degrade to ring-only, never fail the request
+        assert server.run(Q_AGE, {"min": 30}).to_maps() == [
+            {"n": "Alice"}, {"n": "Bob"}]
+        assert server.event_log.sink_failed is True
+        assert server.slow_queries()
+    finally:
+        server.shutdown()
+
+
+def test_fused_record_charge_excludes_nested_build_charges():
+    """Compile seconds sum the wall clock once: an inner count-fused
+    build charged during a record run is subtracted from the
+    fused_record charge, so the non-plan charges never exceed the
+    execute phase they all live inside."""
+    s = _session("tpu")
+    g = create_graph(s, SOCIAL)
+    r = s.cypher_on_graph(
+        g, "MATCH (a:Person)-[:KNOWS]->(b:Person) RETURN count(*) AS c")
+    assert r.to_maps() == [{"c": 2}]
+    charges = r.metrics.get("compile_charges") or []
+    kinds = {c["kind"] for c in charges}
+    assert "fused_record" in kinds
+    non_plan = sum(c["seconds"] for c in charges if c["kind"] != "plan")
+    assert non_plan <= r.metrics["execute_s"] + 1e-6, charges
+
+
+def test_shutdown_releases_graph_tracking_unless_replaced():
+    s = _session("tpu")
+    g1 = create_graph(s, SOCIAL)
+    g2 = create_graph(s, "CREATE (:Person {name:'Zoe', age:9})")
+    a = QueryServer(s, graph=g1, start=False)
+    assert s.memory_ledger.report()["graphs"]["default"]["bytes"] > 0
+    a.shutdown()
+    assert "default" not in s.memory_ledger.report()["graphs"]
+    # a newer server's slot survives the OLD server's (second) shutdown
+    a2 = QueryServer(s, graph=g1, start=False)
+    b = QueryServer(s, graph=g2, start=False)  # replaces the slot
+    a2.shutdown()
+    assert "default" in s.memory_ledger.report()["graphs"]
+    b.shutdown()
+    assert "default" not in s.memory_ledger.report()["graphs"]
+
+
+def test_shape_eviction_is_flagged_not_silent():
+    led = CompileLedger(max_shapes=2)
+    for i in range(3):
+        led.charge("fam", "plan", 0.01, shape=f"s{i}")
+    st = led.stats("fam")
+    assert st["shapes_evicted"] is True
+    # a re-charge of the EVICTED shape cannot be told from a first
+    # compile — the flag (and the summary bound marker) says so
+    assert led.charge("fam", "plan", 0.01, shape="s0")["recompile"] is False
+    assert led.summary()["recompiles_lower_bound"] is True
+    led2 = CompileLedger()
+    led2.charge("f", "plan", 0.01, shape="x")
+    assert led2.summary()["recompiles_lower_bound"] is False

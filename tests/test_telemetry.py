@@ -645,3 +645,54 @@ def test_health_report_and_stats_telemetry(make_session):
         assert all(r["outcome"] == "ok" for r in dump["records"])
     finally:
         server.shutdown()
+
+
+# -- telemetry window shape through config (ISSUE 10 satellite) --------------
+
+def test_config_window_threads_through_at_non_default_shape(fake_clock):
+    """``ServerConfig.telemetry_window_s``/``telemetry_buckets`` reach
+    every rolling instrument: at a 10 s window a sample expires exactly
+    at +10 s (not the 60 s default), compile seconds included."""
+    session = _session("local")
+    server = QueryServer(session, config=ServerConfig(
+        telemetry_window_s=10.0, telemetry_buckets=10), start=False)
+    try:
+        tel = server.telemetry
+        assert tel.window_s == 10.0 and tel.buckets == 10
+        tel.note_result("fam", 0.2, "ok")
+        tel.note_compile(0.7)
+        assert tel.summary()["requests"] == 1
+        assert tel.window_compile_s() == pytest.approx(0.7)
+        fake_clock.advance(9.0)  # still inside the 10 s window
+        assert tel.summary()["requests"] == 1
+        assert tel.summary()["compile"]["events"] == 1
+        fake_clock.advance(2.0)  # past it: everything expired
+        assert tel.summary()["requests"] == 0
+        assert tel.window_compile_s() == 0.0
+        assert tel.summary()["compile"] == {"events": 0, "seconds": 0.0}
+        # at the DEFAULT window the same +11 s advance would NOT expire:
+        # prove the non-default shape actually took effect
+        reg = MetricsRegistry()
+        default = ServingTelemetry(reg)
+        default.note_result("fam", 0.2, "ok")
+        fake_clock.advance(11.0)
+        assert default.summary()["requests"] == 1
+        default.close()
+    finally:
+        server.shutdown()
+
+
+def test_window_compile_seconds_accumulate_and_rotate(fake_clock):
+    reg = MetricsRegistry()
+    tel = ServingTelemetry(reg, window_s=60.0, buckets=60)
+    tel.note_compile(0.5)
+    fake_clock.advance(30.0)
+    tel.note_compile(0.25)
+    assert tel.window_compile_s() == pytest.approx(0.75)
+    # the telemetry.compile_s gauge reads the live window
+    assert reg.snapshot()["telemetry.compile_s"] == pytest.approx(0.75)
+    fake_clock.advance(31.0)  # first charge expired, second still live
+    assert tel.window_compile_s() == pytest.approx(0.25)
+    fake_clock.advance(30.0)
+    assert tel.window_compile_s() == 0.0
+    tel.close()
