@@ -477,7 +477,8 @@ def run_serve_config(on_tpu: bool):
     seq_qps = seq_n / (time.perf_counter() - t0)
 
     # -- closed loop ---------------------------------------------------
-    clients = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    positional = [a for a in sys.argv[2:] if not a.startswith("--")]
+    clients = int(positional[0]) if positional else 8
     per_client = int(os.environ.get("BENCH_SERVE_REQS", "40"))
     server = QueryServer(session, graph=graph, config=ServerConfig(
         workers=2, max_queue=256, max_batch=16, batch_window_s=0.001,
@@ -625,6 +626,67 @@ def run_serve_config(on_tpu: bool):
                                             for a in r["attempts"]}),
         })
 
+    # -- warm path: ragged bucket batching + shape-churn soak ----------
+    # (ISSUE 11 acceptance): 8 clients churn bindings WITHIN warmed
+    # shape buckets across 4 DISTINCT query texts on a ragged server —
+    # compile.recompiles must stay flat (~0) and distinct texts must
+    # demonstrably share batches, both read from the telemetry surfaces.
+    if _remaining() > 25:
+        churn_qs = [
+            (f"MATCH (a:Person)-[:KNOWS]->(b)-[:KNOWS]->(c) "
+             f"WHERE a.name = $seed AND b.age >= {18 + k} "
+             f"RETURN count(*) AS c") for k in range(4)]
+        ragged = QueryServer(session, graph=graph, config=ServerConfig(
+            workers=2, max_queue=4096, max_batch=16,
+            batch_window_s=0.001, ragged_batching=True))
+        for q_ in churn_qs:  # warm every (text, binding) combo once
+            for s_ in seeds:
+                ragged.run(q_, {"seed": s_})
+        snap_c = session.metrics_snapshot()
+        churn_per = int(os.environ.get("BENCH_CHURN_REQS", "24"))
+
+        def churn_client(i):
+            for j in range(churn_per):
+                try:
+                    ragged.run(churn_qs[(i + j) % len(churn_qs)],
+                               {"seed": seeds[(i * churn_per + j)
+                                              % len(seeds)]})
+                except Exception:
+                    pass  # shed under load is fine; recompiles are not
+
+        churners = [_th.Thread(target=churn_client, args=(i,))
+                    for i in range(8)]
+        for t in churners:
+            t.start()
+        for t in churners:
+            t.join()
+        churn_delta = diff_snapshots(snap_c, session.metrics_snapshot())
+        churn_recompiles = churn_delta.get("compile.recompiles", 0)
+        c_batches = churn_delta.get("serve.batch_size.count", 0)
+        c_members = churn_delta.get("serve.batch_size.sum", 0)
+        # distinct-text packing proof: a preloaded queue of alternating
+        # texts must coalesce into shared batches (occupancy > 1)
+        packed = QueryServer(session, graph=graph, start=False,
+                             config=ServerConfig(workers=1, max_batch=16,
+                                                 ragged_batching=True))
+        hs = [packed.submit(churn_qs[i % len(churn_qs)],
+                            {"seed": seeds[i % len(seeds)]})
+              for i in range(8)]
+        packed.start()
+        packed.shutdown()
+        distinct_max = max(h.info["batch_size"] for h in hs)
+        ragged.shutdown()
+        assert churn_recompiles == 0, \
+            f"shape churn within buckets recompiled {churn_recompiles}x"
+        assert distinct_max > 1, "distinct texts never shared a batch"
+        _result.update({
+            "churn_requests": 8 * churn_per,
+            "churn_recompiles": churn_recompiles,
+            "churn_batch_occupancy": round(c_members / c_batches, 3)
+            if c_batches else 0.0,
+            "ragged_distinct_text_batch_max": distinct_max,
+        })
+
     # -- observed-statistics store + Prometheus exposition -------------
     ops_summary = session.op_stats.summary()
     families = session.op_stats.stats()
@@ -690,7 +752,110 @@ def run_serve_config(on_tpu: bool):
         "warmup_cold_hot_families": len(warm["cold_families"]),
     })
     server.shutdown()
+
+    # -- cold-process restart against the persisted plan store ---------
+    # (``serve --cold-process``): persist this process's warm state,
+    # re-launch a FRESH process that warms from the store, and record
+    # its first-query latency / compile charge / recompiles next to the
+    # warmed-server telemetry above.
+    if "--cold-process" in sys.argv and _remaining() > 30:
+        import tempfile
+        from caps_tpu.relational.plan_store import (PlanStore,
+                                                    collect_warm_state)
+        store_path = os.path.join(
+            tempfile.mkdtemp(prefix="caps_planstore_"), "plans.json")
+        saved = PlanStore(store_path,
+                          registry=session.metrics_registry).save(
+            collect_warm_state(session, graph=graph))
+        env = dict(os.environ)
+        env["BENCH_CHILD_ON_TPU"] = "1" if on_tpu else "0"
+        try:
+            assert saved, "plan store save failed"
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "serve",
+                 "--cold-child", store_path, str(n_people),
+                 str(n_edges), str(n_seeds)],
+                capture_output=True, text=True, env=env,
+                timeout=max(20.0, _remaining() - 5))
+            child = json.loads(proc.stdout.strip().splitlines()[-1])
+            _result["cold_process"] = child
+            _result["cold_process_compile_cut"] = round(
+                1.0 - (child.get("first_query_compile_s") or 0.0)
+                / max(compile_s, 1e-9), 4)
+        except Exception as ex:
+            _result["cold_process"] = {
+                "error": f"{type(ex).__name__}: {str(ex)[:200]}"}
     _emit()
+
+
+def run_cold_child(store_path: str, n_people: int, n_edges: int,
+                   n_seeds: int):
+    """The fresh process of ``serve --cold-process``: same graph data
+    (same rng), a server that warms from the persisted plan store at
+    start, then the first client queries — the numbers that prove (or
+    disprove) the cold-cliff kill.  Prints ONE JSON line for the parent
+    to merge."""
+    import numpy as np
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.serve import QueryServer, ServerConfig, WarmupConfig
+
+    rng = np.random.RandomState(42)
+    t_proc = time.perf_counter()
+    session = TPUCypherSession()
+    graph, src, dst, names = build_graph(session, n_people, n_edges,
+                                         n_seeds, rng)
+    ingest_s = time.perf_counter() - t_proc
+    server = QueryServer(session, graph=graph, config=ServerConfig(
+        workers=2, max_queue=256, max_batch=16, batch_window_s=0.001,
+        ragged_batching=True,
+        warmup=WarmupConfig(store_path=store_path, background=False,
+                            save_on_shutdown=False)))
+    wreport = server.warmer.report()
+    # first query = the warmed binding of the canonical family (the
+    # store knows which binding it recorded)
+    binding, stored = {"seed": "Alice"}, []
+    with open(store_path, encoding="utf-8") as f:
+        for fam in json.load(f).get("families", []):
+            if fam["query"] == PARAM_QUERY:
+                binding = fam["params"]
+                stored = fam.get("bindings") or []
+                break
+    exp = expected_paths(src, dst, names, [binding["seed"]])
+    t0 = time.perf_counter()
+    h = server.submit(PARAM_QUERY, binding)
+    rows = h.rows()
+    first_s = time.perf_counter() - t0
+    # a SIBLING warmed binding (the store keeps the compile-charging
+    # rotation) must also charge zero; an UNSEEN binding's residual
+    # charge is reported separately — it is the per-value count-fused
+    # closure build, the honest leftover cost
+    sibling = next((b for b in stored if b != binding), binding)
+    h_sib = server.submit(PARAM_QUERY, sibling)
+    h_sib.rows()
+    seen = {b.get("seed") for b in stored}
+    other = next((nm for nm in names if nm not in seen), "Alice")
+    h2 = server.submit(PARAM_QUERY, {"seed": other})
+    h2.rows()
+    out = {
+        "store_loaded": (wreport.get("store") or {}).get("loaded"),
+        "warmup_s": wreport.get("seconds"),
+        "warmup_families": wreport.get("families_total"),
+        "warmup_completed": wreport.get("completed"),
+        "warmup_streams_seeded": wreport.get("streams_seeded"),
+        "warmup_converged": wreport.get("converged"),
+        "ingest_s": round(ingest_s, 3),
+        "first_query_s": round(first_s, 5),
+        "first_query_latency_s": round(h.info["latency_s"], 5),
+        "first_query_compile_s": h.info["ledger"]["compile_s"],
+        "warmed_sibling_compile_s": h_sib.info["ledger"]["compile_s"],
+        "unseen_binding_compile_s": h2.info["ledger"]["compile_s"],
+        "first_query_ok": rows[0]["c"] == exp[binding["seed"]],
+        "recompiles": server.stats()["compile"]["recompiles"],
+        "telemetry_p99_s":
+            server.health_report()["window"]["latency"]["p99_s"],
+    }
+    server.shutdown()
+    print(json.dumps(out), flush=True)
 
 
 def run_serve_devices_config(on_tpu: bool, devices_n: int):
@@ -1125,6 +1290,15 @@ def run_updates_config(on_tpu: bool):
 
 def main():
     import numpy as np
+    if len(sys.argv) > 1 and sys.argv[1] == "serve" \
+            and "--cold-child" in sys.argv:
+        # the fresh process of `serve --cold-process`: platform comes
+        # from the parent (no probe — it already paid it)
+        i = sys.argv.index("--cold-child")
+        if os.environ.get("BENCH_CHILD_ON_TPU") != "1":
+            _force_cpu()
+        return run_cold_child(sys.argv[i + 1], int(sys.argv[i + 2]),
+                              int(sys.argv[i + 3]), int(sys.argv[i + 4]))
     _install_guards()
     on_tpu = _probe_device()
     if not on_tpu:

@@ -41,7 +41,7 @@ BANNED_TIME_READS = frozenset({
 DEFAULT_SERVE_MODULES = frozenset({
     "__init__.py", "admission.py", "batcher.py", "breaker.py",
     "compaction.py", "deadline.py", "devices.py", "errors.py",
-    "failure.py", "request.py", "retry.py", "server.py",
+    "failure.py", "request.py", "retry.py", "server.py", "warmup.py",
 })
 
 
@@ -99,7 +99,7 @@ class AnalysisConfig:
         "plan_cache", "query", "session", "ops", "serve", "collectives",
         "faults", "fused", "dist_join", "obs", "backend", "tracer",
         "updates", "compaction", "telemetry", "slo", "opstats",
-        "compile", "mem", "slowlog"})
+        "compile", "mem", "slowlog", "warmup", "bucket", "planstore"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)

@@ -242,6 +242,69 @@ class FusedExecutor:
             with self._activate(key, {"mode": None}, force_record=True):
                 return thunk()
 
+    def export_streams(self, graph) -> Dict[str, Dict[str, Any]]:
+        """Warm-path export (relational/plan_store.py): the param-generic
+        size streams recorded for ``graph``, keyed by query text —
+        ``{query: {"pool_len": n, "entries": [...]}}``.  Only streams
+        that can round-trip faithfully are returned (the store layer
+        additionally refuses ``__obj__`` entries — live host objects
+        cannot be persisted)."""
+        gk = getattr(graph, "_fused_epoch", None)
+        out: Dict[str, Dict[str, Any]] = {}
+        if gk is None:
+            return out
+        pool_n = len(self.backend.pool)
+        for (g, query), ent in list(self._generic.items()):
+            if g != gk or ent[1] is None or ent[0] != pool_n \
+                    or ent[2] >= _GENERIC_VIOLATION_LIMIT:
+                # pool-stale streams could never replay in a process
+                # whose pool converges the same way, and a violation-
+                # disabled stream is known-divergent — re-installing it
+                # with a fresh violation count would make the warmed
+                # process WORSE than a clean cold record
+                continue
+            out[query] = {"pool_len": ent[0], "entries": list(ent[1])}
+        return out
+
+    def generic_state(self, graph, query: str) -> str:
+        """``"current"`` — the (graph, query) param-generic stream would
+        replay RIGHT NOW; ``"stale"`` — a stream exists but the pool
+        moved, so the next execution pays a record run (what the warmup
+        convergence pass re-executes to pre-pay); ``"absent"`` — no
+        usable stream exists at all (never recorded, not fuseable, or
+        violation-disabled) and re-executing would not create one worth
+        waiting for."""
+        gk = getattr(graph, "_fused_epoch", None)
+        if gk is None:
+            return "absent"
+        g = self._generic.get((gk, query))
+        if g is None or g[1] is None or g[2] >= _GENERIC_VIOLATION_LIMIT:
+            return "absent"
+        return ("current" if g[0] == len(self.backend.pool)
+                else "stale")
+
+    def seed_generic(self, graph, query: str, pool_len: int,
+                     entries: List[Tuple]) -> bool:
+        """Warm-path seed (serve/warmup.py): install a persisted
+        param-generic size stream for (graph, query) so the FIRST
+        execution in this process replays sync-free instead of paying a
+        record run.  A live (learned-in-process) entry is never
+        clobbered.  Soundness does not rest on the store: the pool-size
+        gate (:meth:`_generic_entry`) ignores a stream recorded against
+        a different string pool, and generic replay relation-checks
+        every served size on device — a wrong stream re-records, it
+        cannot shape results."""
+        gk = _graph_key(graph)
+        if gk is None:
+            return False
+        gkey = (gk, query)
+        if gkey in self._generic:
+            return False
+        self._generic[gkey] = [int(pool_len), list(entries), 0]
+        while len(self._generic) > max(1, self.max_entries):
+            self._generic.pop(next(iter(self._generic)))
+        return True
+
     def forget(self, graph, query: str) -> int:
         """Quarantine hook (caps_tpu/serve/): drop every size memo —
         exact and generic — recorded for (graph, query), so the next
@@ -367,7 +430,18 @@ class FusedExecutor:
         g = self._generic.get(gkey)
         if g is None or g[0] != pool_n:
             # first recording at this pool size seeds the generic stream
-            self._generic[gkey] = [pool_n, list(rec), 0]
+            seeded = list(rec)
+            if g is not None and g[1] is not None:
+                # pool drift forced this re-record, but the OLD stream's
+                # learned magnitudes (widened row caps, merged sizes)
+                # are still valid observations of the workload — carry
+                # them forward when the op structure still aligns, so a
+                # pool change does not reset the convergence headroom
+                carried = _merge_streams(list(g[1]), rec,
+                                         widen_rows=self.backend.bucket)
+                if carried is not None:
+                    seeded = carried
+            self._generic[gkey] = [pool_n, seeded, 0]
         elif g[1] is not None:
             g[1] = _merge_streams(g[1], rec, widen_rows=backend.bucket)
         while len(self._generic) > max(1, self.max_entries):

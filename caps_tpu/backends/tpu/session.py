@@ -25,6 +25,11 @@ class TPUCypherSession(RelationalCypherSession):
     def __init__(self, config=None):
         super().__init__(config)
         self.backend = DeviceBackend(self.config)
+        # one lattice: session-level shape buckets (relational/shapes.py)
+        # ARE the device padding ladder, so seeding from op_stats or the
+        # plan store adapts padding, compile-shape labels, and the
+        # ragged batch keys together
+        self.backend.shapes = self.shape_lattice
         self._factory = DeviceTableFactory(self.backend)
         from caps_tpu.backends.tpu.fused import FusedExecutor
         self.fused = FusedExecutor(self.backend,
@@ -80,11 +85,19 @@ class TPUCypherSession(RelationalCypherSession):
                 if charges is not None:
                     exec_s -= sum(c["seconds"] for c in charges[n0:]
                                   if c["kind"] != "plan")
-                import hashlib
-                sig = hashlib.sha1(
-                    repr(key[2]).encode()).hexdigest()[:10]
+                # Shape label = the BUCKETED parameter shape signature
+                # (relational/shapes.py), not a value hash: two record
+                # runs whose bindings differ only within a bucket are
+                # the SAME compiled shape, so the second counts as a
+                # re-compile — compile.recompiles now measures genuinely
+                # redundant record work (what generic replay + bucket
+                # headroom exist to eliminate), not value churn.
+                from caps_tpu.relational.shapes import (
+                    param_shape_signature, signature_text)
+                sig = signature_text(param_shape_signature(
+                    dict(parameters or {}), lattice=self.shape_lattice))
                 obs.compile_charge("fused_record", max(0.0, exec_s),
-                                   shape=f"g{key[0]}:p{sig}")
+                                   shape=f"g{key[0]}:{sig}")
         if result.metrics is not None:
             result.metrics["ici_bytes"] = be.ici_bytes - before[0]
             result.metrics["dist_joins"] = be.dist_joins - before[1]

@@ -58,6 +58,14 @@ class DeviceBackend:
     def __init__(self, config: EngineConfig):
         self.pool = make_pool()
         self.config = config
+        # Row-capacity bucket lattice (relational/shapes.py): defaults
+        # to config.bucket_sizes — identical rounding to the old
+        # ``config.bucket_for`` — and can be seeded from observed sizes.
+        # The TPU session swaps in its session-level lattice so padding,
+        # compile-shape labels, and the ragged batch keys all share ONE
+        # set of boundaries.
+        from caps_tpu.relational.shapes import ShapeBucketLattice
+        self.shapes = ShapeBucketLattice(config.bucket_sizes)
         if config.compile_cache_dir and \
                 DeviceBackend._persistent_cache_dir != config.compile_cache_dir:
             # Persistent XLA compilation cache: repeat processes reuse
@@ -166,7 +174,7 @@ class DeviceBackend:
                       else None, host=col.host)
 
     def bucket(self, n: int) -> int:
-        return max(1, self.config.bucket_for(n))
+        return max(1, self.shapes.bucket(n))
 
     def consume_count(self, dev_scalar, relation: str = "exact") -> int:
         """Materialize a data-dependent size (see ``count_mode``).

@@ -119,7 +119,11 @@ class MemoryLedger:
     def __init__(self, registry=None, session=None):
         self._session = (weakref.ref(session) if session is not None
                          else lambda: None)
-        self._graphs: Dict[str, Any] = {}  # name -> weakref
+        # name -> {owner key -> graph weakref} (insertion-ordered:
+        # newest owner last).  Several servers may track the same name
+        # — each under its own owner slot, so a short-lived sibling's
+        # release never drops a live server's accounting.
+        self._graphs: Dict[str, Dict[Any, Any]] = {}
         self._lock = make_lock("ledger.MemoryLedger._lock")
         if registry is not None:
             registry.gauge("mem.plan_cache_bytes", fn=self.plan_cache_bytes)
@@ -131,40 +135,55 @@ class MemoryLedger:
 
     # -- tracked graphs -------------------------------------------------
 
-    def track(self, name: str, graph) -> None:
-        """Account ``graph`` under ``name`` (weakly; re-tracking a name
-        replaces it).  The serving tier tracks its default graph."""
+    def track(self, name: str, graph, owner=None) -> None:
+        """Account ``graph`` under ``name`` (weakly).  ``owner`` scopes
+        the entry: each owner (a QueryServer) holds its own slot under
+        the name, so several servers tracking the same graph coexist —
+        a dead sibling's release (:meth:`untrack_if` with its owner)
+        never drops a live server's accounting.  Re-tracking the same
+        (name, owner) replaces that slot only."""
         try:
             ref = weakref.ref(graph)
         except TypeError:  # pragma: no cover — non-weakrefable graph
             ref = (lambda g=graph: g)
+        key = id(owner) if owner is not None else None
         with self._lock:
-            self._graphs[name] = ref
+            slot = self._graphs.setdefault(name, {})
+            slot.pop(key, None)
+            slot[key] = ref  # newest last (dict preserves insertion)
 
     def untrack(self, name: str) -> None:
+        """Drop EVERY owner's entry under ``name``."""
         with self._lock:
             self._graphs.pop(name, None)
 
-    def untrack_if(self, name: str, graph) -> bool:
-        """Untrack ``name`` only while it still refers to ``graph`` — a
-        later :meth:`track` that replaced the name keeps its entry (two
-        servers on one session: the dead one's release must not drop
-        the live one's accounting)."""
+    def untrack_if(self, name: str, graph, owner=None) -> bool:
+        """Untrack ``owner``'s slot under ``name`` only while it still
+        refers to ``graph`` — other owners' slots (and a re-track that
+        replaced this one) are untouched."""
+        key = id(owner) if owner is not None else None
         with self._lock:
-            ref = self._graphs.get(name)
-            if ref is not None and ref() is graph:
-                del self._graphs[name]
-                return True
+            slot = self._graphs.get(name)
+            if slot is not None:
+                ref = slot.get(key)
+                if ref is not None and ref() is graph:
+                    del slot[key]
+                    if not slot:
+                        del self._graphs[name]
+                    return True
         return False
 
     def _live_graphs(self) -> Dict[str, Any]:
         with self._lock:
-            refs = dict(self._graphs)
+            slots = {name: list(slot.values())
+                     for name, slot in self._graphs.items()}
         out = {}
-        for name, ref in refs.items():
-            g = ref()
-            if g is not None:
-                out[name] = g
+        for name, refs in slots.items():
+            for ref in reversed(refs):  # newest live owner wins
+                g = ref()
+                if g is not None:
+                    out[name] = g
+                    break
         return out
 
     # -- gauge callbacks ------------------------------------------------

@@ -10,8 +10,10 @@ from __future__ import annotations
 import abc
 import contextlib
 import hashlib
+import json
 import logging
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 
 logger = logging.getLogger("caps_tpu")
@@ -42,6 +44,7 @@ from caps_tpu.relational.plan_cache import (
     graph_plan_token, param_signature, reset_plan,
 )
 from caps_tpu.relational.planner import RelationalPlanner
+from caps_tpu.relational.shapes import ShapeBucketLattice
 from caps_tpu.relational.table import Table, TableFactory
 from caps_tpu.relational.updates import (
     UpdateError, VersionedGraph, describe_plan, is_update_statement,
@@ -351,6 +354,26 @@ class RelationalCypherSession(CypherSession):
         # per-thread recorder of catalog graphs resolved while planning
         # (they become the cached plan's catalog_deps)
         self._deps_tls = threading.local()
+        # Shape-bucket lattice (relational/shapes.py): the session-level
+        # view of operator-launch size buckets.  Device backends adopt
+        # it as their padding ladder; ``seed_shape_buckets()`` folds
+        # observed op_stats sizes in, and the persistent plan store
+        # (relational/plan_store.py) carries the boundaries across
+        # processes.
+        self.shape_lattice = ShapeBucketLattice(
+            self.config.bucket_sizes, registry=self.metrics_registry)
+        # Warm-path binding recorder: the last JSON-able parameter
+        # binding seen per plan family, captured ONLY on the cold path
+        # (a plan-cache hit records nothing — zero hot-path cost).  The
+        # plan store persists these so a fresh process's AOT warmup
+        # (serve/warmup.py) can re-execute each hot family with a
+        # shape-faithful binding instead of synthetic values.
+        from caps_tpu.obs.lockgraph import make_lock
+        self._warm_bindings: "OrderedDict[str, Tuple[str, Dict]]" = \
+            OrderedDict()
+        self._warm_bindings_lock = make_lock(
+            "session._warm_bindings_lock")
+        self._warm_bindings_cap = 128
 
     # -- backend SPI --------------------------------------------------------
 
@@ -473,6 +496,13 @@ class RelationalCypherSession(CypherSession):
                         f"({d1[:12]} vs {d2[:12]}): {query!r}")
                 result.metrics["determinism_digest"] = d1
         self._stamp_compile_charges(result, charges)
+        if charges:
+            # warm-path binding capture: ANY binding that crossed a
+            # compile boundary (a cold plan, a fused record, a
+            # per-value count-pushdown build) is a binding AOT warmup
+            # must cover — record it for the plan store
+            self._note_warm_binding(normalize_query(query), query,
+                                    dict(parameters or {}))
         return result
 
     @staticmethod
@@ -634,6 +664,56 @@ class RelationalCypherSession(CypherSession):
         else:
             raise ValueError(f"unknown trace format {fmt!r}")
         return path
+
+    # -- warm path (serve/warmup.py + relational/plan_store.py) --------------
+
+    #: distinct compile-charging bindings retained per family — enough
+    #: to cover a per-value compile cache's rotation (the count-pushdown
+    #: closures) without letting ad-hoc values grow the store
+    _WARM_BINDINGS_PER_FAMILY = 4
+
+    def _note_warm_binding(self, family: str, query: str,
+                           params: Mapping[str, Any]) -> None:
+        """Record a compile-charging binding for the family — only when
+        the values are JSON-able (the store is plain JSON; anything else
+        is silently skipped, warmup then simply cannot cover that
+        binding).  Distinct bindings are kept up to a small per-family
+        cap: every one of them crossed a compile boundary, so every one
+        is a binding AOT warmup should pre-pay."""
+        try:
+            token = json.dumps(dict(params), sort_keys=True)
+            clean = json.loads(token)
+        except (TypeError, ValueError):
+            return
+        with self._warm_bindings_lock:
+            ent = self._warm_bindings.pop(family, None)
+            if ent is None:
+                ent = (query, [], set())
+            q, bindings, tokens = ent
+            if token not in tokens and \
+                    len(bindings) < self._WARM_BINDINGS_PER_FAMILY:
+                tokens.add(token)
+                bindings.append(clean)
+            self._warm_bindings[family] = (q, bindings, tokens)
+            while len(self._warm_bindings) > self._warm_bindings_cap:
+                self._warm_bindings.popitem(last=False)
+
+    def warmup_bindings(self) -> List[Dict[str, Any]]:
+        """Per hot plan family: the original query text and every
+        retained compile-charging binding — the plan store's family
+        entries (``relational/plan_store.py collect_warm_state``)."""
+        with self._warm_bindings_lock:
+            return [{"family": fam, "query": q,
+                     "params": dict(bs[0]) if bs else {},
+                     "bindings": [dict(b) for b in bs]}
+                    for fam, (q, bs, _toks) in
+                    self._warm_bindings.items()]
+
+    def seed_shape_buckets(self) -> int:
+        """Fold observed operator-launch sizes (``op_stats`` actual max
+        rows) into the session's shape-bucket lattice.  Returns how many
+        boundaries were added."""
+        return self.shape_lattice.seed_from_op_stats(self.op_stats)
 
     def _plan_cache_key(self, graph: RelationalCypherGraph, query: str,
                         params: Mapping[str, Any]) -> Optional[Tuple]:

@@ -1,0 +1,188 @@
+"""Shape bucketing: a bounded lattice of operator-launch sizes.
+
+Every device-side operator launch pads its rows up to a capacity bucket
+(``backends/tpu/table.py``), so XLA programs compile once per
+(plan, bucket) rather than once per exact row count.  Until now the
+bucket boundaries were a fixed geometric ladder
+(``EngineConfig.bucket_sizes``); this module makes them a first-class,
+*observable* lattice:
+
+* :class:`ShapeBucketLattice` rounds sizes up power-of-two-ish and can
+  be **seeded from observed sizes** (``session.op_stats`` actual rows,
+  or a persisted plan store's recorded maxima — the tensor-path costing
+  idea of "Premature Dimensional Collapse ..." in PAPERS.md applied to
+  padding: boundaries go where the workload's sizes actually land, so
+  padding waste shrinks where it matters and the bucket count stays
+  bounded);
+* :func:`param_shape_signature` maps a parameter binding to a
+  **value-independent bucketed shape token** — the compile-shape label
+  the compile ledger charges under (two bindings whose sizes fall in
+  one bucket are ONE compiled shape, so ``compile.recompiles`` counts
+  genuinely redundant compile work, not value churn) and the ragged
+  micro-batcher's bucket key (serve/batcher.py): requests whose shapes
+  agree per-bucket pack into one shared device launch, the
+  Ragged-Paged-Attention pad-and-pack shape (PAPERS.md) with the
+  DeviceTable validity masks playing the exact-row-mask role.
+
+The lattice only ever grows monotonically (boundaries are added, never
+removed, and never beyond ``max_buckets``): a mid-session seed can
+change which bucket NEW launches pad to, but every already-recorded
+fused size stream stays valid — recorded capacities are plain integers,
+and the generic-replay relation checks (backends/tpu/table.py) verify
+every served size on device regardless of where the boundaries sit.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping, Optional, Tuple
+
+from caps_tpu.obs.lockgraph import make_lock
+
+#: the fixed ladder EngineConfig ships — kept as the un-seeded default
+#: so an un-adapted lattice buckets exactly like ``config.bucket_for``
+DEFAULT_BUCKETS: Tuple[int, ...] = (256, 1024, 4096, 16384, 65536,
+                                    262144, 1048576)
+
+
+def _pow2_ceil(n: int) -> int:
+    n = max(1, int(n))
+    return 1 << (n - 1).bit_length()
+
+
+class ShapeBucketLattice:
+    """A bounded, monotonically growing set of row-capacity boundaries.
+
+    ``bucket(n)`` rounds ``n`` up to the smallest boundary >= n (beyond
+    the largest boundary: repeated doubling, exactly like the old
+    ``EngineConfig.bucket_for``).  ``seed(sizes)`` inserts the
+    power-of-two ceiling of each observed size as a new boundary —
+    bounded by ``max_buckets``, so ad-hoc size churn cannot fragment the
+    lattice (and with it the per-bucket compile cache) without bound.
+    """
+
+    def __init__(self, buckets: Optional[Iterable[int]] = None,
+                 max_buckets: int = 64, registry=None):
+        base = tuple(buckets) if buckets else DEFAULT_BUCKETS
+        self.max_buckets = max(len(base), int(max_buckets))
+        self._buckets: Tuple[int, ...] = tuple(sorted(
+            {max(1, int(b)) for b in base}))
+        self._lock = make_lock("shapes.ShapeBucketLattice._lock")
+        self._seeded_c = (registry.counter("bucket.seeded")
+                          if registry is not None else None)
+        if registry is not None:
+            registry.gauge("bucket.boundaries",
+                           fn=lambda: len(self._buckets))
+
+    def bucket(self, n: int) -> int:
+        n = int(n)
+        buckets = self._buckets  # tuple read is atomic; no lock on reads
+        for b in buckets:
+            if n <= b:
+                return b
+        b = buckets[-1]
+        while b < n:
+            b *= 2
+        return b
+
+    def signature(self, n: int) -> str:
+        """The bucket token of a size — stable across every value that
+        pads to the same capacity."""
+        return f"b{self.bucket(n)}"
+
+    def boundaries(self) -> Tuple[int, ...]:
+        return self._buckets
+
+    def seed(self, sizes: Iterable[int]) -> int:
+        """Insert the power-of-two ceiling of each observed size as a
+        boundary (idempotent; bounded).  Returns how many boundaries
+        were actually added."""
+        wanted = sorted({_pow2_ceil(s) for s in sizes if int(s) > 0})
+        added = 0
+        with self._lock:
+            have = set(self._buckets)
+            for b in wanted:
+                if b in have or len(have) >= self.max_buckets:
+                    continue
+                have.add(b)
+                added += 1
+            if added:
+                self._buckets = tuple(sorted(have))
+        if added and self._seeded_c is not None:
+            self._seeded_c.inc(added)
+        return added
+
+    def seed_from_op_stats(self, op_stats) -> int:
+        """Seed from the observed-statistics store (obs/telemetry.py):
+        each (plan family, operator)'s actual max row count becomes a
+        candidate boundary — the sizes real traffic launches at."""
+        sizes = []
+        try:
+            for ops in op_stats.stats().values():
+                for st in ops.values():
+                    sizes.append(int(st.get("rows_max") or 0))
+        except Exception:  # pragma: no cover — stats shape drift
+            return 0
+        return self.seed(sizes)
+
+
+# -- module-default lattice (the batcher's bucket key source) ----------------
+
+_default_lock = make_lock("shapes._default_lock")
+_default: Optional[ShapeBucketLattice] = None
+
+
+def default_lattice() -> ShapeBucketLattice:
+    """Process-shared lattice for callers with no session at hand (the
+    micro-batcher's bucket keys).  Sessions hold their own instance."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = ShapeBucketLattice()
+        return _default
+
+
+# -- parameter shape signatures ----------------------------------------------
+
+def param_shape_token(value: Any,
+                      lattice: Optional[ShapeBucketLattice] = None) -> str:
+    """A value-independent shape token for one parameter binding:
+    scalars reduce to their coarse type, containers to type + LENGTH
+    BUCKET (the only aspect of a container value that shapes a compiled
+    launch), maps additionally to their key set (pattern-property
+    expansion plans per key — plan_cache.PlanParams.map_keys)."""
+    lat = lattice if lattice is not None else default_lattice()
+    if value is None:
+        return "null"
+    if isinstance(value, bool):
+        return "bool"
+    if isinstance(value, int):
+        return "int"
+    if isinstance(value, float):
+        return "float"
+    if isinstance(value, str):
+        return "str"
+    if isinstance(value, bytes):
+        return "bytes"
+    if isinstance(value, (list, tuple)):
+        return f"list:{lat.signature(len(value))}"
+    if isinstance(value, (set, frozenset)):
+        return f"set:{lat.signature(len(value))}"
+    if isinstance(value, Mapping):
+        keys = ",".join(sorted(str(k) for k in value))
+        return f"map[{keys}]"
+    return f"?{type(value).__name__}"
+
+
+def param_shape_signature(params: Mapping[str, Any],
+                          lattice: Optional[ShapeBucketLattice] = None
+                          ) -> Tuple[Tuple[str, str], ...]:
+    """Sorted (name, shape token) tuple — hashable (the ragged batch
+    key component) and stable across parameter VALUES whose shapes land
+    in the same buckets."""
+    return tuple(sorted((k, param_shape_token(v, lattice))
+                        for k, v in params.items()))
+
+
+def signature_text(sig: Tuple[Tuple[str, str], ...]) -> str:
+    """Compact string form of a signature — the compile ledger's shape
+    label."""
+    return "{" + ",".join(f"{k}:{t}" for k, t in sig) + "}"

@@ -28,6 +28,10 @@ The layer between many client threads and one engine session
     serve/compaction.py background compaction of a versioned default
                         graph (delta-store backlog folding), health in
                         stats()["compaction"]
+    serve/warmup.py     AOT server warmup: precompile the hot plan
+                        families at start (explicit list or persistent
+                        plan store — relational/plan_store.py), outcome
+                        in stats()["warmup"] / health_report()
 
 Engine hooks this package owns: ``RelationalCypherSession.cypher_batch``
 (one batched pass over a cached plan), the deadline checkpoints in
@@ -64,6 +68,8 @@ _LAZY = {
     # ServerConfig, so clients naturally look for it here
     "SLOConfig": "caps_tpu.obs.telemetry",
     "Compactor": "caps_tpu.serve.compaction",
+    "WarmupConfig": "caps_tpu.serve.warmup",
+    "ServerWarmup": "caps_tpu.serve.warmup",
     "ReplicaSet": "caps_tpu.serve.devices",
     "DeviceReplica": "caps_tpu.serve.devices",
     "replicate_graph": "caps_tpu.serve.devices",

@@ -20,6 +20,19 @@ Never batched (batch key None): EXPLAIN/PROFILE requests (PROFILE
 mutates session profiling state and must run alone), queries against
 graphs that cannot anchor a plan-cache entry, and parameter sets whose
 signatures diverge — those fall back to per-request execution.
+
+**Ragged bucket batching** (``ServerConfig.ragged_batching``): the
+batch key widens from the exact plan-key family to a (graph, parameter
+shape-bucket signature) — see ``relational/shapes.py`` — so *different*
+queries whose operator launches are shape-compatible pack into one
+shared device launch window.  Exactness is untouched: every member
+still executes its OWN cached plan with per-member parameter rebinding
+(and, on device backends, bucket-padded tables with validity masks —
+the exact-row masks of the pad-and-pack scheme), and per-member
+exception isolation is the same ``cypher_batch`` contract as before.
+The request keeps its exact plan key alongside (``Request.plan_key``)
+for everything that must stay per-family: circuit breakers, plan
+quarantine, and telemetry labels.
 """
 from __future__ import annotations
 
@@ -29,30 +42,52 @@ from caps_tpu.serve.admission import AdmissionController
 from caps_tpu.serve.request import Request
 
 
-def batch_key(graph: Any, query: str,
-              params: Mapping[str, Any]) -> Tuple[Optional[str],
-                                                  Optional[Tuple]]:
-    """(query mode, batch compatibility key).  Key None = never batch.
-    Update statements report mode ``"write"``: they never coalesce (each
-    is one atomic commit with its own read half) and the server routes
-    them to the versioned handle instead of a pinned snapshot."""
+def request_keys(graph: Any, query: str, params: Mapping[str, Any],
+                 ragged: bool = False, lattice: Any = None
+                 ) -> Tuple[Optional[str], Optional[Tuple],
+                            Optional[Tuple]]:
+    """(query mode, plan key, batch key).  Plan key None = the request
+    can never anchor shared cached state (EXPLAIN/PROFILE, writes,
+    uncacheable graphs); batch key None = never batch.  Update
+    statements report mode ``"write"``: they never coalesce (each is one
+    atomic commit with its own read half) and the server routes them to
+    the versioned handle instead of a pinned snapshot.  With ``ragged``
+    the batch key is the shape-bucket signature instead of the exact
+    plan family."""
     from caps_tpu.frontend.parser import normalize_query, query_mode
     from caps_tpu.relational.plan_cache import (graph_plan_token,
                                                 param_signature)
     from caps_tpu.relational.updates import is_update_query
     mode, body = query_mode(query)
     if mode is not None:
-        return mode, None
+        return mode, None, None
     if is_update_query(body):
-        return "write", None
+        return "write", None, None
     gtok = graph_plan_token(graph)
     if gtok is None:
-        return None, None
+        return None, None, None
     try:
         sig = param_signature(params)
     except Exception:
-        return None, None
-    return None, (gtok, normalize_query(body), sig)
+        return None, None, None
+    plan_key = (gtok, normalize_query(body), sig)
+    if not ragged:
+        return None, plan_key, plan_key
+    # ``lattice`` should be the serving session's shape lattice so the
+    # bucket key agrees with the padding ladder and compile-shape
+    # labels (one boundary set); None falls back to the process default
+    from caps_tpu.relational.shapes import param_shape_signature
+    return None, plan_key, (gtok, "bucket",
+                            param_shape_signature(params, lattice))
+
+
+def batch_key(graph: Any, query: str,
+              params: Mapping[str, Any]) -> Tuple[Optional[str],
+                                                  Optional[Tuple]]:
+    """(query mode, exact-family batch key) — the pre-ragged view, kept
+    for callers that only need plan-key compatibility."""
+    mode, _plan_key, key = request_keys(graph, query, params)
+    return mode, key
 
 
 class MicroBatcher:

@@ -98,11 +98,13 @@ class Request:
     """One admitted unit of work, owned by the queue then a worker."""
 
     __slots__ = ("request_id", "query", "params", "graph", "priority",
-                 "scope", "batch_key", "mode", "handle", "enqueued_t")
+                 "scope", "batch_key", "mode", "handle", "enqueued_t",
+                 "plan_key")
 
     def __init__(self, query: str, params: Mapping[str, Any], graph: Any,
                  priority: int, scope: CancelScope,
-                 batch_key: Optional[Tuple], mode: Optional[str]):
+                 batch_key: Optional[Tuple], mode: Optional[str],
+                 plan_key: Optional[Tuple] = None):
         self.request_id = next(_request_ids)
         self.query = query
         self.params = dict(params)
@@ -110,8 +112,14 @@ class Request:
         self.priority = priority
         self.scope = scope
         #: micro-batch compatibility key (serve/batcher.py); None =
-        #: never batched (EXPLAIN/PROFILE, uncacheable graphs)
+        #: never batched (EXPLAIN/PROFILE, uncacheable graphs).  With
+        #: ragged bucket batching this is the SHAPE key, wider than the
+        #: plan family.
         self.batch_key = batch_key
+        #: the exact plan-cache key family — what breakers, quarantine,
+        #: and telemetry labels stay keyed by (defaults to batch_key for
+        #: requests built before ragged batching existed)
+        self.plan_key = plan_key if plan_key is not None else batch_key
         #: "explain" | "profile" | None — PROFILE is executed alone
         self.mode = mode
         self.handle = QueryHandle(self)

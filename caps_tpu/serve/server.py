@@ -96,6 +96,7 @@ from caps_tpu.serve.failure import (FATAL, TRANSIENT, attribute_device,
                                     classify, device_of)
 from caps_tpu.serve.request import INTERACTIVE, QueryHandle, Request
 from caps_tpu.serve.retry import RetryPolicy
+from caps_tpu.serve.warmup import ServerWarmup, WarmupConfig
 
 _UNSET = object()
 
@@ -159,6 +160,18 @@ class ServerConfig:
     #: seconds a batch leader waits for followers (0 = batch only what
     #: is already queued — no added leader latency)
     batch_window_s: float = 0.0
+    #: ragged bucket batching (serve/batcher.py + relational/shapes.py):
+    #: the batch key widens from the exact plan family to the parameter
+    #: SHAPE-BUCKET signature, so different queries' shape-compatible
+    #: launches pack into one shared batch window.  Members keep their
+    #: own cached plans (results stay exact) and their own plan-family
+    #: breakers/quarantine (``Request.plan_key``).
+    ragged_batching: bool = False
+    #: AOT warmup at server start (serve/warmup.py): precompile the hot
+    #: families — from an explicit list or a persistent plan store —
+    #: through the normal compile boundaries, so the compile ledger
+    #: proves coverage before traffic arrives.  None = no warmup.
+    warmup: Optional["WarmupConfig"] = None
     #: default per-request budget (None = no deadline)
     default_deadline_s: Optional[float] = None
     default_priority: int = INTERACTIVE
@@ -264,11 +277,13 @@ class QueryServer:
                 registry=registry, event_log=self.event_log)
         #: memory ledger (obs/ledger.py): account the served graph so
         #: ``stats()["memory"]`` carries its base/delta footprint.
-        #: The "default" slot is last-writer-wins across servers on one
-        #: session; shutdown releases it only if still ours.
+        #: Tracked under THIS server as owner: several servers on one
+        #: session each hold their own "default" slot, and shutdown
+        #: releases only ours — a short-lived sibling can never drop a
+        #: live server's accounting.
         ledger = getattr(session, "memory_ledger", None)
         if ledger is not None:
-            ledger.track("default", self._default_graph)
+            ledger.track("default", self._default_graph, owner=self)
         self.admission = AdmissionController(
             registry, max_queue=self.config.max_queue,
             per_priority_limits=self.config.per_priority_limits,
@@ -292,6 +307,11 @@ class QueryServer:
             cooldown_s=self.config.device_cooldown_s,
             on_change=lambda: self.admission.set_active_workers(
                 self.devices.live_count() or 1))
+        #: AOT warmup driver (serve/warmup.py) — None unless configured.
+        #: ``start()`` runs it (inline or background per its config);
+        #: progress/outcome ride ``stats()["warmup"]``.
+        self.warmer = (ServerWarmup(self, self.config.warmup)
+                       if self.config.warmup is not None else None)
         self._completed = registry.counter("serve.completed")
         self._failed = registry.counter("serve.failed")
         self._cancelled = registry.counter("serve.cancelled")
@@ -342,6 +362,12 @@ class QueryServer:
         if self._started:
             return self
         self._started = True
+        if self.warmer is not None:
+            # inline warmup (background=False) completes BEFORE the
+            # worker pool spins up — the first admitted request then
+            # finds a fully compiled hot set; background warmup runs
+            # concurrently with serving and reports progress in stats()
+            self.warmer.start()
         if self.config.devices is not None:
             bindings = list(self.devices.replicas)
         else:
@@ -401,15 +427,19 @@ class QueryServer:
         return not still_running
 
     def _release_resources(self) -> None:
-        """Full-stop cleanup: telemetry gauges leave the live set, the
-        event-log file sink closes, and the memory ledger drops this
-        server's graph slot (only if a newer server has not re-tracked
-        it) so a dead server stops inflating ``mem.tracked_graph_bytes``."""
+        """Full-stop cleanup: the warmer persists its store (before the
+        event log closes, so a save failure still events), telemetry
+        gauges leave the live set, the event-log file sink closes, and
+        the memory ledger drops this server's graph slot (only if a
+        newer server has not re-tracked it) so a dead server stops
+        inflating ``mem.tracked_graph_bytes``."""
+        if self.warmer is not None:
+            self.warmer.finalize()
         self.telemetry.close()
         self.event_log.close()
         ledger = getattr(self.session, "memory_ledger", None)
         if ledger is not None:
-            ledger.untrack_if("default", self._default_graph)
+            ledger.untrack_if("default", self._default_graph, owner=self)
 
     def __enter__(self) -> "QueryServer":
         return self.start()
@@ -450,8 +480,11 @@ class QueryServer:
             from caps_tpu.relational.updates import is_update_query
             if not is_update_query(query):
                 graph = graph.current()
-        mode, key = _batcher.batch_key(graph, query, params)
-        req = Request(query, params, graph, priority, scope, key, mode)
+        mode, plan_key, key = _batcher.request_keys(
+            graph, query, params, ragged=self.config.ragged_batching,
+            lattice=getattr(self.session, "shape_lattice", None))
+        req = Request(query, params, graph, priority, scope, key, mode,
+                      plan_key=plan_key)
         if getattr(graph, "snapshot_version", None) is not None:
             req.handle.info["snapshot_version"] = graph.snapshot_version
         self.admission.offer(req)  # may raise ServerClosed / Overloaded
@@ -486,6 +519,8 @@ class QueryServer:
         out["batching"] = self._batching_stats(snap)
         out["compile"] = self._compile_summary()
         out["memory"] = self._memory_report()
+        out["warmup"] = (self.warmer.report()
+                         if self.warmer is not None else None)
         out["slow_queries"] = (len(self.slow_log.records())
                                if self.slow_log is not None else None)
         return out
@@ -539,6 +574,10 @@ class QueryServer:
             "compile": self._compile_summary(),
             "memory": self._memory_report(),
             "opstats": self.session.op_stats.summary(),
+            # AOT warmup progress/outcome (serve/warmup.py) — the
+            # cold-start story next to the compile ledger it spends
+            "warmup": (self.warmer.report()
+                       if self.warmer is not None else None),
         }
 
     def warmup_report(self, families: Optional[List[str]] = None
@@ -707,11 +746,13 @@ class QueryServer:
         return live
 
     def _family(self, req: Request):
-        """The circuit breaker's key: the plan-cache key family the
-        micro-batcher groups by, or a per-query fallback for requests
-        that can never batch (EXPLAIN/PROFILE, uncacheable graphs)."""
-        if req.batch_key is not None:
-            return req.batch_key
+        """The circuit breaker's key: the EXACT plan-cache key family
+        (not the ragged bucket key — a poisoned plan must trip only its
+        own family's breaker), or a per-query fallback for requests
+        that can never anchor one (EXPLAIN/PROFILE, uncacheable
+        graphs)."""
+        if req.plan_key is not None:
+            return req.plan_key
         return ("solo", req.mode, req.query)
 
     def _requeue(self, reqs: List[Request]) -> None:
@@ -739,6 +780,16 @@ class QueryServer:
 
     def _execute_live(self, live: List[Request],
                       replica: DeviceReplica) -> None:
+        if len({self._family(r) for r in live}) > 1:
+            # ragged bucket batch: members belong to DIFFERENT plan
+            # families.  Breaker admission is per member — an open
+            # family fast-fails only its own members, a half-open one's
+            # member runs alone as that family's probe, and the rest
+            # proceed as the shared batch below.
+            live = self._admit_ragged(live, replica)
+            if not live:
+                return
+            return self._dispatch_batch(live, replica)
         family = self._family(live[0])
         verdict, retry_after = self.breaker.admit(family)
         if verdict == REJECT:
@@ -797,6 +848,57 @@ class QueryServer:
                 break
             if not live or not healed:
                 return
+        self._dispatch_batch(live, replica)
+
+    def _admit_ragged(self, live: List[Request],
+                      replica: DeviceReplica) -> List[Request]:
+        """Per-member breaker admission for a mixed-family (ragged
+        bucket) batch: open families fast-fail their members, a
+        half-open family's first member executes ALONE as its probe
+        (success closes the breaker, failure re-opens it — exactly the
+        single-family trial semantics, scoped to one member), everyone
+        else is returned for the shared dispatch."""
+        kept: List[Request] = []
+        for req in live:
+            family = self._family(req)
+            verdict, retry_after = self.breaker.admit(family)
+            if verdict == REJECT:
+                self._finish(req, CircuitOpen(
+                    f"plan family circuit breaker is open "
+                    f"(retry after {retry_after:.3f}s)",
+                    retry_after_s=retry_after))
+                continue
+            if verdict == TRIAL:
+                req.handle.info["batch_size"] = 1
+                self._batches.inc()
+                self._batch_hist.observe(1)
+                self.telemetry.note_batch(1)
+                outcome = self._execute_single(req, 1, replica)
+                if isinstance(outcome, BaseException):
+                    outcome = self._recover(req, outcome, 1, replica)
+                if isinstance(outcome, CancellationError):
+                    self.breaker.abort_trial(family)
+                elif isinstance(outcome, BaseException):
+                    self.breaker.record_failure(family, outcome)
+                    self._finish(req, outcome)
+                    self.telemetry.auto_dump("breaker_trip")
+                    self.event_log.emit(
+                        "breaker.trip", request_id=req.request_id,
+                        family=self._family_label(req),
+                        trigger="failed_half_open_trial")
+                    continue
+                else:
+                    self.breaker.record_success(family)
+                self._finish(req, outcome)
+                continue
+            kept.append(req)
+        return kept
+
+    def _dispatch_batch(self, live: List[Request],
+                        replica: DeviceReplica) -> None:
+        """One shared device dispatch of breaker-admitted requests, with
+        per-member outcome bookkeeping (breaker records land on each
+        member's OWN plan family — a ragged batch mixes several)."""
         n = len(live)
         self._batches.inc()
         self._batch_hist.observe(n)
@@ -849,17 +951,18 @@ class QueryServer:
             if isinstance(outcome, BaseException):
                 pending.append((req, outcome))
             else:
-                self.breaker.record_success(family)
+                self.breaker.record_success(self._family(req))
                 self._finish(req, outcome)
         for req, exc in pending:
             outcome = self._recover(req, exc, 0, replica)
-            # breaker bookkeeping on the request's FINAL outcome;
-            # cancellation/deadline expiry is the budget's verdict, not
-            # the family's
+            # breaker bookkeeping on the request's FINAL outcome — onto
+            # the member's OWN plan family; cancellation/deadline expiry
+            # is the budget's verdict, not the family's
             tripped = False
             if isinstance(outcome, BaseException):
                 if not isinstance(outcome, CancellationError):
-                    tripped = self.breaker.record_failure(family, outcome)
+                    tripped = self.breaker.record_failure(
+                        self._family(req), outcome)
                     if tripped and not req.handle.info.get("quarantined"):
                         # this failure tripped the family open: evict its
                         # shared cached state so the half-open trial (and
@@ -867,7 +970,7 @@ class QueryServer:
                         # unless the recovery ladder already did
                         self._quarantine(req, replica)
             else:
-                self.breaker.record_success(family)
+                self.breaker.record_success(self._family(req))
             self._finish(req, outcome)
             if tripped:
                 # AFTER the finish: the tripping request is in the
@@ -1147,8 +1250,8 @@ class QueryServer:
         flight recorder: the normalized query text for batchable
         requests (the batch key's middle element), else mode + raw
         text."""
-        if req.batch_key is not None:
-            return str(req.batch_key[1])[:120]
+        if req.plan_key is not None:
+            return str(req.plan_key[1])[:120]
         return f"{req.mode or 'solo'}:{req.query[:100]}"
 
     def _flight(self, req: Request, exc: Optional[BaseException],

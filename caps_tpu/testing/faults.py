@@ -12,6 +12,9 @@ This module provides them:
   the next execution then heals) or permanently (``n_times=None``);
 * :func:`slow_operator` — deterministic per-operator delay (deadline /
   cancellation tests without sleep-and-hope timing);
+* :func:`slow_compile` — deterministic delay + accounting inflation at
+  every compile-boundary charge (obs/compile.py), so cold-cliff and
+  AOT-warmup tests run on the fake clock instead of real XLA compiles;
 * :func:`device_oom` — a realistic ``XlaRuntimeError``-shaped
   ``RESOURCE_EXHAUSTED``, injected at an operator boundary or into
   ingest placement;
@@ -246,6 +249,45 @@ def slow_operator(op_name: str, delay_s: float):
 
     with OPERATOR_PATCH.hooked(cls, hook):
         yield
+
+
+@contextlib.contextmanager
+def slow_compile(delay_s: float, n_times: Optional[int] = None,
+                 kinds=None):
+    """While active, compile-boundary charges are deterministically slow:
+    every :class:`caps_tpu.obs.compile.CompileLedger` charge (optionally
+    filtered to ``kinds`` — e.g. ``("plan", "fused_record")``) sleeps
+    ``delay_s`` through ``obs.clock`` and reports ``seconds + delay_s``,
+    so on a fake clock a "35-second cold compile" costs zero real time
+    and its ledger accounting is exactly assertable.
+
+    The cold-cliff and AOT-warmup tests (tests/test_warmup.py) use this
+    instead of relying on real XLA compile times: ``n_times=1`` makes
+    only the FIRST boundary slow (the cliff a warmed process must not
+    pay again), ``n_times=None`` slows every one.  Installed/restored
+    under the shared fault lock like every other patch point; injections
+    count ``faults.injected.slow_compile``.  Yields the budget
+    (``.injected``)."""
+    from caps_tpu.obs.compile import CompileLedger
+    budget = _Budget(n_times)
+    want = None if kinds is None else frozenset(kinds)
+
+    with OPERATOR_PATCH._lock:
+        orig = CompileLedger.charge
+
+        def slowed(self, family, kind, seconds, shape=None):
+            if (want is None or kind in want) and budget.take():
+                _count_injection("slow_compile")
+                clock.sleep(delay_s)
+                seconds = float(seconds) + delay_s
+            return orig(self, family, kind, seconds, shape=shape)
+
+        CompileLedger.charge = slowed
+    try:
+        yield budget
+    finally:
+        with OPERATOR_PATCH._lock:
+            CompileLedger.charge = orig
 
 
 @contextlib.contextmanager
