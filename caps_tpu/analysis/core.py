@@ -99,7 +99,8 @@ class AnalysisConfig:
         "plan_cache", "query", "session", "ops", "serve", "collectives",
         "faults", "fused", "dist_join", "obs", "backend", "tracer",
         "updates", "compaction", "telemetry", "slo", "opstats",
-        "compile", "mem", "slowlog", "warmup", "bucket", "planstore"})
+        "compile", "mem", "slowlog", "warmup", "bucket", "planstore",
+        "cost", "stats", "replan"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)

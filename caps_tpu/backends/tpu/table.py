@@ -121,6 +121,10 @@ class DeviceBackend:
         self.dist_joins = 0       # radix exchange joins executed
         self.broadcast_joins = 0  # all_gather broadcast joins executed
         self.salted_joins = 0     # radix joins that salted hot keys
+        # last cost-model distribution decision (relational/cost.py
+        # choose_dist_strategy) — the okapi sharded path's EXPLAIN /
+        # debugging surface for radix-vs-salted-vs-broadcast
+        self.last_dist_decision: Optional[Dict] = None
         # Size-sync routing for the fused executor (backends/tpu/fused.py):
         # None = eager (device->host sync per data-dependent size);
         # ("record", sizes)       = eager + record every size in order;
@@ -912,7 +916,17 @@ class DeviceTable(Table):
                 a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
                 for a in arrs)
 
-        if other._n <= cfg.broadcast_join_threshold:
+        # strategy comes from the SAME model function the planner's
+        # EXPLAIN annotation consults (relational/cost.py) — thresholds
+        # are model inputs, and the runtime call prices ACTUAL row
+        # counts where the plan-time call priced estimates.  "salted"
+        # resolves on the radix path below once the hot-key sample
+        # confirms (or refutes) the sketch's skew prediction.
+        from caps_tpu.relational.cost import choose_dist_strategy
+        strategy, decision = choose_dist_strategy(self._n, other._n,
+                                                  n, cfg)
+        be.last_dist_decision = {"strategy": strategy, **decision}
+        if strategy == "broadcast":
             prog1 = DJ.make_broadcast_join(be.mesh, axis, n_l, n_r,
                                            1, left_join, True)
             (max_total, live_r) = prog1(l_key, l_ok, r_key, r_ok,

@@ -8,22 +8,45 @@ mount empty; SURVEY.md §2).
 Both rewrites matter much more here than on Spark: filtering before an
 ``Expand`` shrinks the gather/join the device executes, and narrowing scan
 labels picks a smaller node table outright.
+
+With a cost model attached (relational/cost.py — ROADMAP item 3) the
+optimizer additionally runs **cost-ranked join-order enumeration** over
+Expand chains: a linear pattern ``(v0)-[r1]->(v1)-...->(vk)`` can be
+rooted at either end, and the two orientations' padded-device costs
+(seeded by the ingest-time statistics sketch and calibrated by observed
+actuals) decide which end scans.  A selective predicate at the FAR end
+of a chain — ``MATCH (a)-[:L]->(t) WHERE t.name = $x`` — re-roots the
+scan at ``t`` and walks the edges backwards, shrinking every frontier
+the device launches.  The enumeration is bounded (a chain has exactly
+two roots) and conservative: reversal needs a ``REORDER_MARGIN`` win,
+Optional/Exists subtrees are opaque (their rhs embeds the lhs as a
+structural prefix relational planning matches by equality), and
+var-length / into / repeated-var shapes are left alone.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional as Opt, Tuple
+from typing import Dict, List, Optional as Opt, Tuple
 
 from caps_tpu.ir import exprs as E
+from caps_tpu.ir.pattern import Direction
 from caps_tpu.logical import ops as L
-from caps_tpu.okapi.types import CTNode
+from caps_tpu.okapi.types import CTNode, CTRelationship
 
 
 _MISSING = object()
 
 
+def _flip(d: Direction) -> Direction:
+    if d == Direction.OUTGOING:
+        return Direction.INCOMING
+    if d == Direction.INCOMING:
+        return Direction.OUTGOING
+    return d  # BOTH is orientation-free
+
+
 class LogicalOptimizer:
-    def __init__(self):
+    def __init__(self, cost_model=None):
         # Optional/ExistsSemiJoin rhs trees embed the lhs chain as a shared
         # structural prefix that relational planning matches by equality to
         # thread the row-id tag.  While rewriting such an rhs, the embedded
@@ -31,9 +54,14 @@ class LogicalOptimizer:
         # and never descended into (and _push won't push predicates across
         # it), so the prefix stays structurally identical on both sides.
         self._barriers = {}
+        #: relational/cost.py CostModel (None = heuristic-only: the
+        #: pre-item-3 behavior, also the bench.py plan-mode baseline)
+        self._model = cost_model
 
     def process(self, plan: L.LogicalPlan) -> L.LogicalPlan:
         root = self._rewrite(plan.root)
+        if self._model is not None:
+            root = self._reorder(root)
         return L.LogicalPlan(root, plan.result_fields, plan.returns_graph)
 
     def _rewrite(self, op: L.LogicalOperator) -> L.LogicalOperator:
@@ -148,3 +176,134 @@ class LogicalOptimizer:
         # NodeScan (different var), Start, Optional, Aggregate, Project,
         # Select, Distinct, OrderBy, Skip, Limit, Unwind, unions: stop here.
         return None
+
+    # -- cost-ranked join-order enumeration (chain re-rooting) -------------
+
+    def _reorder(self, op: L.LogicalOperator) -> L.LogicalOperator:
+        """Walk the plan; at the head of every maximal Filter/Expand
+        chain, enumerate both roots and keep the cheaper orientation.
+        Optional/Exists subtrees are opaque (see class docstring)."""
+        if isinstance(op, (L.Optional, L.ExistsSemiJoin)):
+            return op
+        if isinstance(op, (L.Filter, L.Expand)):
+            matched, replacement = self._try_reverse(op)
+            if matched:
+                # whether reversed or kept, this segment was enumerated
+                # once — never re-enumerate its inner sub-chains
+                return replacement if replacement is not None else op
+        return op.map_children(
+            lambda c: self._reorder(c)
+            if isinstance(c, L.LogicalOperator) else c)
+
+    def _match_chain(self, head: L.LogicalOperator):
+        """Match the subtree under ``head`` as ``Filter*/Expand`` chain
+        segments over one ``NodeScan(Start)``.  Returns (scan, hops
+        bottom-up, predicates) or None.  Constraints mirror the
+        count-pushdown matcher: fixed hops only, no into, all node and
+        rel vars distinct (a repeated var is a cycle — its join order is
+        not a chain's)."""
+        preds: List[E.Expr] = []
+        hops_top_down: List[L.Expand] = []
+        cur = head
+        while True:
+            if isinstance(cur, L.Filter):
+                preds.extend(LogicalOptimizer._split(cur.predicate))
+                cur = cur.parent
+            elif isinstance(cur, L.Expand):
+                if cur.into or cur in self._barriers:
+                    return None
+                hops_top_down.append(cur)
+                cur = cur.parent
+            elif isinstance(cur, L.NodeScan):
+                if not isinstance(cur.parent, L.Start) \
+                        or cur.parent.qgn is not None \
+                        or cur in self._barriers:
+                    return None
+                scan = cur
+                break
+            else:
+                return None
+        if not hops_top_down:
+            return None
+        hops = list(reversed(hops_top_down))  # bottom-up: hop 1 first
+        expected = scan.var
+        for h in hops:
+            if h.source != expected:
+                return None  # star/branch shape, not a chain
+            expected = h.target
+        node_vars = [scan.var] + [h.target for h in hops]
+        rel_vars = [h.rel for h in hops]
+        if len(set(node_vars)) != len(node_vars) \
+                or len(set(rel_vars)) != len(rel_vars):
+            return None
+        return scan, hops, preds
+
+    def _try_reverse(self, head: L.LogicalOperator):
+        """(matched, replacement): enumerate the chain under ``head``
+        both ways; ``replacement`` is the reversed chain when the model
+        prices it decisively cheaper, else None (keep)."""
+        got = self._match_chain(head)
+        if got is None:
+            return False, None
+        scan, hops, preds = got
+        model = self._model
+        preds_by_var: Dict[str, List[E.Expr]] = {}
+        for p in preds:
+            vs = {v.name for v in E.vars_in(p)}
+            if len(vs) == 1:
+                preds_by_var.setdefault(next(iter(vs)), []).append(p)
+
+        def sel(var: str, labels) -> float:
+            return model.selectivity(preds_by_var.get(var, ()), labels)
+
+        labels_of = {scan.var: scan.labels}
+        for h in hops:
+            labels_of[h.target] = h.target_labels
+        fwd_cost, _ = model.chain_cost(
+            scan.labels, sel(scan.var, scan.labels),
+            [(h.rel_types, h.direction, h.target_labels,
+              sel(h.target, h.target_labels)) for h in hops])
+        rev_seed = hops[-1].target
+        rev_hops_desc = []
+        for j in range(len(hops) - 1, -1, -1):
+            h = hops[j]
+            tgt = hops[j - 1].target if j > 0 else scan.var
+            rev_hops_desc.append((h.rel_types, _flip(h.direction),
+                                  labels_of[tgt], sel(tgt,
+                                                      labels_of[tgt])))
+        rev_cost, _ = model.chain_cost(
+            labels_of[rev_seed], sel(rev_seed, labels_of[rev_seed]),
+            rev_hops_desc)
+        reverse = model.chain_orientation(fwd_cost, rev_cost)
+        model.note("join_order",
+                   chain="->".join(v for v in labels_of),
+                   fwd_cost=round(fwd_cost, 1),
+                   rev_cost=round(rev_cost, 1),
+                   chosen="reversed" if reverse else "forward")
+        if not reverse:
+            return True, None
+        # rebuild: scan the far end, walk the edges backwards
+        env: Dict[str, object] = {}
+        for node in [scan] + hops:
+            env.update(dict(node.fields))
+        seed_labels = labels_of[rev_seed]
+        out: L.LogicalOperator = L.NodeScan(
+            scan.parent, rev_seed, seed_labels,
+            fields=((rev_seed, CTNode(seed_labels)),))
+        for j in range(len(hops) - 1, -1, -1):
+            h = hops[j]
+            tgt = hops[j - 1].target if j > 0 else scan.var
+            rel_type = env.get(h.rel) or CTRelationship(
+                frozenset(h.rel_types))
+            new_fields = out.fields + ((h.rel, rel_type),
+                                       (tgt, CTNode(labels_of[tgt])))
+            out = L.Expand(out, h.target, h.rel, h.rel_types, tgt,
+                           labels_of[tgt], _flip(h.direction),
+                           into=False, fields=new_fields)
+        if preds:
+            pred = preds[0] if len(preds) == 1 else E.Ands(tuple(preds))
+            out = self._optimize_filter(
+                L.Filter(out, pred, fields=out.fields))
+        if model._registry is not None:
+            model._registry.counter("cost.reorders").inc()
+        return True, out

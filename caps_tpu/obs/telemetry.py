@@ -274,30 +274,58 @@ class OpStatsStore:
     (relational/session.py) — the same entries PROFILE annotates, so
     fused-replay granularity carries over unchanged (rows under generic
     replay are the served sizes, exact under per-op sync).  The store is
-    the substrate for cost-based planning (ROADMAP item 4): until the
-    planner emits its own estimates, each key's running-mean row count
-    stands in as the estimate, and an observation diverging from it by
-    more than ``divergence_factor`` (either direction) ticks the
-    per-key and registry divergence counters — the signal a cost model
-    uses to retire a cached plan whose cardinality assumptions rotted.
+    the substrate for cost-based planning (ROADMAP item 3, closed by
+    relational/cost.py): when an entry carries the planner's OWN
+    estimate (``est_rows``, stamped by ``cost.annotate_plan``), the
+    divergence check measures **model error** — an observation off the
+    *estimate* by more than ``divergence_factor`` (either direction)
+    ticks the per-key and registry divergence counters, and a family
+    whose executions keep diverging becomes a **re-plan candidate**
+    (``take_replan_candidates``): the session retires its cached plan
+    through the quarantine path and re-plans with calibrated
+    statistics.  Entries without an estimate keep the legacy behavior
+    (the running mean stands in, drift past it diverges).
 
     Families are LRU-bounded (``max_families``): a long-lived server
     cycling through ad-hoc queries cannot grow the store without bound.
     """
 
     def __init__(self, registry=None, max_families: int = 128,
-                 divergence_factor: float = 4.0):
+                 divergence_factor: float = 4.0,
+                 replan_threshold: int = 2,
+                 divergence_floor: int = 256,
+                 bucket_fn=None):
         self.max_families = max(1, int(max_families))
         self.divergence_factor = max(1.0, float(divergence_factor))
+        #: model error below this many rows (both sides) never counts:
+        #: everything under the smallest shape bucket pads identically,
+        #: so the mis-estimate has no device-cost consequence and a
+        #: re-plan would be pure churn (tiny test graphs included)
+        self.divergence_floor = max(0, int(divergence_floor))
+        #: rows -> padded-bucket boundary (the session's shape lattice):
+        #: model error that does not CHANGE the padded bucket changes no
+        #: launch shape and no device cost, so it never diverges — this
+        #: also absorbs fused-replay entries whose observed "rows" are
+        #: the served (padded) size rather than the exact count
+        self.bucket_fn = bucket_fn
+        #: model-divergent EXECUTIONS (not op entries) a family needs
+        #: before it is surfaced as a re-plan candidate
+        self.replan_threshold = max(1, int(replan_threshold))
         self._families: Dict[str, Dict[str, Dict[str, Any]]] = {}
         #: total per-operator entries folded in (the health_report
         #: ``opstats`` section reads it without needing the registry)
         self.recorded = 0
+        #: per-family model-divergent execution counts since the last
+        #: candidate hand-off, and the pending candidate set
+        self._diverged_execs: Dict[str, int] = {}
+        self._replan_candidates: List[str] = []
         self._lock = make_lock("telemetry.OpStatsStore._lock")
         self._recorded_c = (registry.counter("opstats.recorded")
                             if registry is not None else None)
         self._diverged_c = (registry.counter("opstats.divergences")
                             if registry is not None else None)
+        self._replan_cand_c = (registry.counter("replan.candidates")
+                               if registry is not None else None)
         if registry is not None:
             registry.gauge("opstats.families", fn=self.family_count)
 
@@ -308,6 +336,8 @@ class OpStatsStore:
         if not op_metrics:
             return
         diverged = 0
+        model_diverged = False
+        new_candidate = False
         with self._lock:
             self.recorded += len(op_metrics)
             fam = self._families.pop(family, None)
@@ -315,11 +345,14 @@ class OpStatsStore:
                 fam = {}
             self._families[family] = fam  # LRU touch: newest position
             while len(self._families) > self.max_families:
-                self._families.pop(next(iter(self._families)))
+                dropped = next(iter(self._families))
+                self._families.pop(dropped)
+                self._diverged_execs.pop(dropped, None)
             for entry in op_metrics:
                 op_id = f"{entry.get('op_id', -1)}:{entry.get('op', '?')}"
                 st = fam.get(op_id)
                 rows = int(entry.get("rows") or 0)
+                model_est = entry.get("est_rows")
                 if st is None:
                     st = fam[op_id] = {
                         "op": entry.get("op", "?"), "executions": 0,
@@ -327,10 +360,30 @@ class OpStatsStore:
                         "rows_min": rows, "rows_max": rows,
                         "bytes_total": 0, "wall_s_total": 0.0,
                         "device_s_total": 0.0, "divergences": 0}
-                else:
+                f = self.divergence_factor
+                if model_est is not None:
+                    # model error: actual vs the PLANNER's estimate —
+                    # checked on every execution, first included (the
+                    # model's error is known immediately), but only when
+                    # the error is big enough to matter in DEVICE terms:
+                    # above the bucket floor AND landing the launch in a
+                    # different padded bucket than the estimate priced
+                    # (see __init__ — costs are padded rows, so error
+                    # inside one bucket is free by construction)
+                    est = float(model_est)
+                    st["est_rows"] = int(est)
+                    st["est_err"] = round((rows + 1.0) / (est + 1.0), 4)
+                    ratio = (rows + 1.0) / (est + 1.0)
+                    if (ratio > f or ratio < 1.0 / f) \
+                            and max(rows, est) >= self.divergence_floor \
+                            and self._bucket_changed(rows, est):
+                        st["divergences"] += 1
+                        diverged += 1
+                        model_diverged = True
+                elif st["executions"] > 0:
+                    # legacy drift check against the running mean
                     est = st["rows_mean"]
                     ratio = (rows + 1.0) / (est + 1.0)
-                    f = self.divergence_factor
                     if ratio > f or ratio < 1.0 / f:
                         st["divergences"] += 1
                         diverged += 1
@@ -344,10 +397,55 @@ class OpStatsStore:
                 st["wall_s_total"] += float(entry.get("seconds") or 0.0)
                 if entry.get("device_s") is not None:
                     st["device_s_total"] += float(entry["device_s"])
+            if model_diverged:
+                n = self._diverged_execs.get(family, 0) + 1
+                if n >= self.replan_threshold:
+                    self._diverged_execs[family] = 0
+                    if family not in self._replan_candidates:
+                        self._replan_candidates.append(family)
+                        new_candidate = True
+                else:
+                    self._diverged_execs[family] = n
         if self._recorded_c is not None:
             self._recorded_c.inc(len(op_metrics))
         if diverged and self._diverged_c is not None:
             self._diverged_c.inc(diverged)
+        if new_candidate and self._replan_cand_c is not None:
+            self._replan_cand_c.inc()
+
+    def _bucket_changed(self, rows: int, est: float) -> bool:
+        """True when actual and estimate pad to different shape-bucket
+        boundaries (always True without a lattice)."""
+        if self.bucket_fn is None:
+            return True
+        try:
+            return (self.bucket_fn(max(1, int(rows)))
+                    != self.bucket_fn(max(1, int(est))))
+        except Exception:  # pragma: no cover — advisory only
+            return True
+
+    def take_replan_candidates(self) -> List[str]:
+        """Families whose executions crossed the model-divergence
+        threshold since the last call — handed off exactly once (the
+        session retires their cached plans and re-plans with updated
+        statistics; relational/session.py ``_maybe_replan``)."""
+        with self._lock:
+            out, self._replan_candidates = self._replan_candidates, []
+            return out
+
+    def reset_family(self, family: str) -> None:
+        """Drop one family's recorded per-operator history (divergence
+        counts survive in the registry counters).  Called when the
+        family's cached plan retires for re-planning: the history was
+        measured under the plan the model just declared mis-planned,
+        and operator ids do NOT transfer across plan shapes — a re-plan
+        calibrated against the old plan's operators would inherit its
+        aliased row means and re-diverge forever (plan churn).  The
+        re-plan prices from the refreshed statistics prior; history
+        restarts under the new plan's operators."""
+        with self._lock:
+            self._families.pop(family, None)
+            self._diverged_execs.pop(family, None)
 
     # -- reads ----------------------------------------------------------
 
@@ -374,8 +472,12 @@ class OpStatsStore:
             ops = sum(len(v) for v in self._families.values())
             div = sum(st["divergences"] for v in self._families.values()
                       for st in v.values())
+            est = sum(1 for v in self._families.values()
+                      for st in v.values() if "est_rows" in st)
             return {"families": len(self._families), "operators": ops,
-                    "recorded": self.recorded, "divergences": div}
+                    "recorded": self.recorded, "divergences": div,
+                    "estimated_operators": est,
+                    "pending_replans": len(self._replan_candidates)}
 
 
 # -- the serving telemetry hub -----------------------------------------------

@@ -77,6 +77,21 @@ class EngineConfig:
     # (VarExpandOp strategy "matrix") instead of the join cascade.
     use_ring: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_RING", True))
+    # Cost-based planning (relational/cost.py + relational/stats.py,
+    # ROADMAP item 3): ingest-time cardinality/degree/skew sketches seed
+    # a tensor-path cost model that (a) re-roots Expand chains at their
+    # cheaper end (logical/optimizer.py), (b) chooses count-pushdown vs
+    # cascade and the sharded distribution strategy, and (c) stamps
+    # per-operator row estimates so opstats.divergences measures MODEL
+    # error and a diverging cached family re-plans itself.  Off = the
+    # pre-item-3 fixed heuristics (the bench.py plan-mode baseline).
+    use_cost_model: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_COST_MODEL", True))
+    # Divergence-triggered re-planning: model-divergent executions per
+    # plan family before its cached plan retires through the quarantine
+    # path and re-plans with calibrated statistics.  0 disables.
+    replan_threshold: int = dataclasses.field(
+        default_factory=lambda: _env_int("CAPS_TPU_REPLAN_THRESHOLD", 2))
     # Hand-scheduled distributed joins (parallel/dist_join.py, SURVEY.md
     # §5.8): with a 1-D mesh, large-large joins ride an all_to_all radix
     # exchange (each row crosses ICI once) instead of GSPMD's layout, and
@@ -85,6 +100,9 @@ class EngineConfig:
         default_factory=lambda: _env_bool("CAPS_TPU_DIST_JOIN", True))
     # Build sides at or under this many rows broadcast instead of
     # exchanging (Spark's autoBroadcastJoinThreshold analog, in rows).
+    # With the cost model on this is a model INPUT — the broadcast
+    # prior — not a hard cutover (relational/cost.py
+    # choose_dist_strategy); <= 0 disables broadcasting either way.
     broadcast_join_threshold: int = dataclasses.field(
         default_factory=lambda: _env_int("CAPS_TPU_BROADCAST_ROWS", 4096))
     # Skew salting for the radix exchange (surgical: ONLY detected-hot

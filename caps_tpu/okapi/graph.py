@@ -76,6 +76,14 @@ class PropertyGraph(abc.ABC):
     def union_all(self, *others: "PropertyGraph") -> "PropertyGraph":
         ...
 
+    def statistics(self):
+        """Ingest-time statistics sketch (cardinalities, degree
+        distributions, skew — ``caps_tpu.relational.stats``) used by
+        the cost-based planner; None when the graph keeps none.
+        Concrete relational graphs compute it lazily at construction
+        time and refresh it across versioned commits."""
+        return None
+
 
 class CypherRecords(abc.ABC):
     """A table of Cypher values — the tabular part of a query result."""

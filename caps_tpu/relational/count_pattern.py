@@ -84,6 +84,15 @@ class _Unsuitable(Exception):
     """Runtime bail-out: compute via the fallback join plan instead."""
 
 
+def _walk_expr(e: E.Expr):
+    """Every sub-expression of ``e`` (itself included)."""
+    stack = [e]
+    while stack:
+        x = stack.pop()
+        yield x
+        stack.extend(c for c in x.children if isinstance(c, E.Expr))
+
+
 def _split(pred: E.Expr) -> Tuple[E.Expr, ...]:
     if isinstance(pred, E.Ands):
         out: List[E.Expr] = []
@@ -407,6 +416,27 @@ class CountPatternOp(RelationalOperator):
     #     and the whole chain is traced into one jax.jit closure;
     #   * per ITERATION: one program dispatch, zero host syncs.
 
+    def _value_keyed(self) -> bool:
+        """True when the fused closure must key on parameter VALUES —
+        only shapes whose static structure bakes predicate results in
+        at build time (the cycle op's host-side compaction).  The plain
+        chain keys on the parameter SHAPE SIGNATURE instead
+        (relational/shapes.py): predicate masks rebuild per binding as
+        cheap eager args, the jitted program itself never recompiles —
+        so unseen bindings stop charging ``count_fused`` compiles (the
+        PR 10 cold-process residual)."""
+        return False
+
+    def _shape_key(self, backend, params):
+        """The value-independent closure-cache key component."""
+        from caps_tpu.relational.shapes import param_shape_signature
+        session = getattr(self.context, "session", None)
+        lattice = getattr(session, "shape_lattice", None)
+        try:
+            return param_shape_signature(params, lattice)
+        except Exception:
+            return None
+
     def _fused_total(self):
         backend = getattr(self.context.factory, "backend", None)
         if backend is None or backend.mesh is not None:
@@ -415,10 +445,21 @@ class CountPatternOp(RelationalOperator):
             return None
         from caps_tpu.backends.tpu.fused import _graph_key, _params_key
         gk = _graph_key(self.graph)
-        pk = _params_key(self.context.parameters)
+        params = self.context.parameters
+        pk = _params_key(params)
         if gk is None or pk is None:
             return None
-        key = (gk, pk, len(backend.pool), self._plan_sig())
+        value_keyed = self._value_keyed()
+        key_sig = pk if value_keyed else self._shape_key(backend, params)
+        if key_sig is None:
+            value_keyed, key_sig = True, pk
+        # pool length only keys VALUE-keyed entries: a shape-keyed
+        # closure's jitted program carries no pooled string data (the
+        # predicate masks rebuild per binding against the live pool),
+        # and keying on it would turn every new interned string value
+        # back into a compile-charging miss
+        key = (gk, key_sig, len(backend.pool) if value_keyed else -1,
+               self._plan_sig())
         entry = backend.fused_count_fns.get(key)
         if entry is _NO_FUSE:
             return None
@@ -430,7 +471,7 @@ class CountPatternOp(RelationalOperator):
             saved = backend.count_mode
             backend.count_mode = None
             try:
-                entry = self._build_fused(backend, gk)
+                built = self._build_fused(backend, gk)
             finally:
                 backend.count_mode = saved
             fns = backend.fused_count_fns
@@ -439,10 +480,27 @@ class CountPatternOp(RelationalOperator):
             # negative results are cached too: repeats of an unfusable
             # query must not pay the build probing (and its host syncs)
             # every execution
-            fns[key] = _NO_FUSE if entry is None else entry
-            if entry is None:
+            if built is None:
+                fns[key] = _NO_FUSE
                 return None
-        fn, args, valid = entry
+            fn, args, valid, make_args = built
+            entry = {"run": fn, "valid": valid, "make_args": make_args,
+                     "args": args,
+                     "token": pk if make_args is not None else None}
+            fns[key] = entry
+        else:
+            fn, valid = entry["run"], entry["valid"]
+            args = entry["args"]
+            if entry["token"] is not None and entry["token"] != pk:
+                # unseen binding, same shape: rebuild ONLY the
+                # predicate-mask args (eager device ops — no XLA
+                # compile, no count_fused charge; the jitted program
+                # reuses its trace because the arg shapes agree)
+                args = entry["make_args"](params)
+                if args is None:
+                    return None
+                entry["args"] = args
+                entry["token"] = pk
         # roofline numerator: the device arrays the fused program reads
         # per execution (this op has no evaluated children to account)
         import jax
@@ -454,7 +512,8 @@ class CountPatternOp(RelationalOperator):
             # Compile ledger (obs/compile.py): a fused_count_fns miss is
             # a compile boundary — the closure build plus the FIRST
             # dispatch (where jax traces + XLA-compiles the program).
-            # Cache hits below charge nothing.
+            # Cache hits (including fresh bindings in a seen shape
+            # bucket) charge nothing.
             import hashlib
             sig = hashlib.sha1(
                 repr(self._plan_sig()).encode()).hexdigest()[:10]
@@ -581,10 +640,12 @@ class CountPatternOp(RelationalOperator):
         st["ids"][key] = entry
         return entry
 
-    def _fused_okpred(self, scan, spec: NodeSpec, order):
-        """Predicate mask over a node scan, evaluated ONCE at closure-build
-        time (pure function of graph data + params), permuted into id
-        order.  Returns None if a predicate has no device path."""
+    def _fused_okpred(self, scan, spec: NodeSpec, order, params=None):
+        """Predicate mask over a node scan, evaluated at closure-build
+        time — or re-evaluated per unseen binding when the closure is
+        shape-keyed (pure function of graph data + ``params``) —
+        permuted into id order.  Returns None if a predicate has no
+        device path."""
         from caps_tpu.backends.tpu.expr import (
             DeviceExprCompiler, UnsupportedOnDevice,
         )
@@ -592,12 +653,14 @@ class CountPatternOp(RelationalOperator):
         import jax.numpy as jnp
         header, t, static_ok, _hids, host_ok = scan
         backend = self.context.factory.backend
+        if params is None:
+            params = self.context.parameters
         if not spec.preds:
             # no device work: permute the static mask host-side, upload
             # once (a numpy arg would re-transfer on every call)
             return backend.place_rows(jnp.asarray(host_ok[order]))
         compiler = DeviceExprCompiler(t._cols, t.capacity, header,
-                                      self.context.parameters,
+                                      params,
                                       backend.pool, t.row_ok)
 
         def rename(e: E.Expr) -> E.Expr:
@@ -654,24 +717,19 @@ class CountPatternOp(RelationalOperator):
             return None  # let the eager path raise _Unsuitable
 
         seed_order, seed_ends = self._fused_ids(st, self.seed.labels, n)
-        seed_okps = self._fused_okpred(seed_scan, self.seed, seed_order)
-        if seed_okps is None:
-            return None
         # Hops often share a target spec (e.g. two unlabeled nodes): build
         # each distinct mask once and index into it, so the program carries
-        # no duplicate dense-vector subgraphs.
-        masks: List[tuple] = []
+        # no duplicate dense-vector subgraphs.  The distinct-mask ORDER is
+        # structural (labels + pred shapes), so the per-binding args
+        # builder below reproduces it exactly for every parameter value.
+        uniq_masks: List[tuple] = []  # (spec, scan) per distinct mask
         mask_index: List[int] = []
         uniq: Dict[tuple, int] = {}
         for spec, scan in zip(mask_specs, mask_scans):
             k = (spec.labels, tuple(repr(p) for p in spec.preds))
             if k not in uniq:
-                order, ends = self._fused_ids(st, spec.labels, n)
-                okps = self._fused_okpred(scan, spec, order)
-                if okps is None:
-                    return None
-                uniq[k] = len(masks)
-                masks.append((okps, ends))
+                uniq[k] = len(uniq_masks)
+                uniq_masks.append((spec, scan))
             mask_index.append(uniq[k])
         mask_index = tuple(mask_index)
         hop_edges = [self._fused_edges(st, rk, h.direction, n)
@@ -836,12 +894,37 @@ class CountPatternOp(RelationalOperator):
                 total = total - sub
             return jnp.zeros((cap1,), jnp.int64).at[0].set(total)
 
-        args = (seed_okps, seed_ends, tuple(masks), tuple(hop_edges), corr,
-                corr3)
+        def build_args(params):
+            """The parameter-dependent half of the closure: predicate
+            masks evaluated for ONE binding (eager device ops, no XLA
+            compile).  Everything else — edges, segment boundaries,
+            corrections — is graph-static and captured above."""
+            seed_okps = self._fused_okpred(seed_scan, self.seed,
+                                           seed_order, params)
+            if seed_okps is None:
+                return None
+            masks: List[tuple] = []
+            for spec, scan in uniq_masks:
+                order, ends = self._fused_ids(st, spec.labels, n)
+                okps = self._fused_okpred(scan, spec, order, params)
+                if okps is None:
+                    return None
+                masks.append((okps, ends))
+            return (seed_okps, seed_ends, tuple(masks),
+                    tuple(hop_edges), corr, corr3)
+
+        args = build_args(self.context.parameters)
+        if args is None:
+            return None
         # Host-side validity: the count row is always valid, and a numpy
         # mask lets result materialization skip one device round trip.
         valid = np.ones((cap1,), bool)
-        return (run, args, valid)
+        all_preds = list(self.seed.preds) + [p for s, _sc in uniq_masks
+                                             for p in s.preds]
+        has_param_preds = any(
+            isinstance(x, E.Param)
+            for p in all_preds for x in _walk_expr(p))
+        return (run, args, valid, build_args if has_param_preds else None)
 
     def _build_corr3(self, backend, st, n: int):
         """Static data for the 3-hop isomorphism correction.
@@ -1271,6 +1354,15 @@ class CountCycleOp(CountPatternOp):
         return (super()._plan_sig(), "cycle",
                 tuple(sorted(set(ch.rel_types))), ch.direction)
 
+    def _value_keyed(self) -> bool:
+        """The cycle lowering bakes its (possibly param-dependent)
+        predicate masks into host-side static compaction at build time,
+        so predicated cycles stay VALUE-keyed; pred-free cycles are
+        fully static and share one shape-keyed closure."""
+        return bool(self.seed.preds
+                    or any(h.target.preds for h in self.hops)
+                    or self.close_hop.target.preds)
+
     def _compute_pushdown(self):
         fused = self._fused_total()
         if fused is None:
@@ -1376,7 +1468,7 @@ class CountCycleOp(CountPatternOp):
         valid = np.ones((cap1,), bool)
         if P == 0 or keys.shape[0] == 0:
             zero = jnp.zeros((cap1,), jnp.int64)
-            return ((lambda: zero), (), valid)
+            return ((lambda: zero), (), valid, None)
 
         B = self._BATCH
         d_cumW = backend.place_rows(jnp.asarray(cumW))
@@ -1423,7 +1515,7 @@ class CountCycleOp(CountPatternOp):
             int(x.nbytes) for x in (d_cumW, d_e1f, d_e1t, d_starts2,
                                     d_adj2, d_keys))
         self.strategy = "cycle-probe"
-        return (run, (), valid)
+        return (run, (), valid, None)
 
     def _pretty_args(self):
         ch = self.close_hop

@@ -278,6 +278,12 @@ class RelationalOperator(abc.ABC):
             }
             if device_s is not None:
                 entry["device_s"] = device_s
+            # cost-model estimate (relational/cost.py annotate_plan):
+            # ride the entry so the observed-statistics store measures
+            # MODEL error, not drift from its own running mean
+            est = getattr(self, "est_rows", None)
+            if est is not None:
+                entry["est_rows"] = int(est)
             self.context.op_metrics.append(entry)
             # run-stamped measurement for PROFILE (obs/profile.py): the
             # op_metrics LIST identity tags which run the entry belongs
@@ -322,8 +328,19 @@ class RelationalOperator(abc.ABC):
     def pretty(self, depth: int = 0) -> str:
         label = type(self).__name__.removesuffix("Op")
         extra = self._pretty_args()
+        est = getattr(self, "est_rows", None)
+        suffix = ""
+        if est is not None:
+            # estimated-vs-chosen in EXPLAIN: the cost model's row
+            # estimate (src: model prior or observed calibration) and,
+            # on sharded joins, the planned distribution strategy
+            src = getattr(self, "est_source", "model")
+            suffix = f"  ~rows={est} ({src})"
+            dist = getattr(self, "dist_strategy", None)
+            if dist is not None:
+                suffix += f" dist={dist}"
         lines = [("    " * depth) + ("└─" if depth else "") + label
-                 + (f"({extra})" if extra else "")]
+                 + (f"({extra})" if extra else "") + suffix]
         for c in self.children:
             lines.append(c.pretty(depth + 1))
         return "\n".join(lines)

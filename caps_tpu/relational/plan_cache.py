@@ -245,6 +245,11 @@ class CachedPlan:
     #: revalidates against the live catalog, so a mutation of graph X
     #: invalidates exactly X's dependents — never the whole cache.
     catalog_deps: Tuple = ()
+    #: the raw query text this plan answered — divergence-triggered
+    #: retirement (``evict_family``) needs it to ALSO forget the fused
+    #: executor's recorded program for (graph, query): a re-planned
+    #: tree replaying the old plan's size stream would mis-gather
+    query_text: str = ""
     # Serializes executions of THIS plan: the operator tree and its
     # runtime context are shared mutable state (parameter dict, per-op
     # result memos), so concurrent serving threads that hit the same
@@ -427,6 +432,28 @@ class PlanCache:
     @property
     def quarantined(self) -> int:
         return self._quarantined.value
+
+    def evict_family(self, family: str) -> List[CachedPlan]:
+        """Divergence-triggered retirement (relational/session.py
+        ``_maybe_replan``): quarantine every cached plan whose key's
+        normalized-query-text component matches ``family`` — the same
+        eviction path the serving tier's failure containment uses, so
+        the next execution re-plans from scratch with fresh (calibrated)
+        statistics.  Returns the dropped plans so the caller can ALSO
+        retire their fused recordings (a re-planned tree must never
+        replay the retired plan's size stream)."""
+        with self._lock:
+            stale = [k for k in self._entries if k[0] == family]
+        dropped: List[CachedPlan] = []
+        for k in stale:
+            with self._lock:
+                plans = self._entries.pop(k, None)
+                if not plans:
+                    continue
+                self._count -= len(plans)
+                self._quarantined.inc(len(plans))
+                dropped.extend(plans)
+        return dropped
 
     def evict_dependents(self, qgn=None) -> int:
         """Scoped catalog eviction (the session's catalog subscription):

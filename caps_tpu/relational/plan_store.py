@@ -131,10 +131,23 @@ def collect_warm_state(session, graph=None,
             "stream": streams.get(b["query"]),
             "rows_max": rows_max.get(b["family"], 0),
         })
+    stats_payload = None
+    if graph is not None:
+        # persist the ingest-time statistics sketch alongside the warm
+        # state (relational/stats.py): a fresh process's cost model can
+        # price its first plans from the PREVIOUS process's observed
+        # graph shape instead of an empty prior
+        try:
+            stats = g.statistics() if g is not None else None
+            if stats is not None and stats.total_nodes:
+                stats_payload = stats.to_payload()
+        except Exception:  # pragma: no cover — the store is a hint
+            stats_payload = None
     return {
         "fingerprint": store_fingerprint(),
         "lattice": list(session.shape_lattice.boundaries()),
         "families": out_families,
+        "stats": stats_payload,
     }
 
 

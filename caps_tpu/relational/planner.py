@@ -30,10 +30,15 @@ GraphResolver = Callable[[QualifiedGraphName], RelationalCypherGraph]
 class RelationalPlanner:
     def __init__(self, context: R.RelationalRuntimeContext,
                  ambient_graph: RelationalCypherGraph,
-                 graph_resolver: Opt[GraphResolver] = None):
+                 graph_resolver: Opt[GraphResolver] = None,
+                 cost_model=None):
         self.context = context
         self.ambient_graph = ambient_graph
         self.graph_resolver = graph_resolver
+        #: relational/cost.py CostModel — physical-strategy choices
+        #: (count-pushdown vs cascade here; distribution strategy via
+        #: cost.annotate_plan) consult it when present
+        self.cost_model = cost_model
         self._entity_ctx_cache: Dict[int, R.EntityContext] = {}
         self.current_graph = ambient_graph
         self._memo: Dict[L.LogicalOperator, R.RelationalOperator] = {}
@@ -344,9 +349,16 @@ class RelationalPlanner:
                     for n, a in op.aggregations]
             default = R.AggregateOp(ctx, parent, group, aggs)
             from caps_tpu.relational.count_pattern import (
-                try_plan_count_pushdown,
+                CountCycleOp, try_plan_count_pushdown,
             )
             pushed = try_plan_count_pushdown(self, op, default)
+            if pushed is not None and self.cost_model is not None \
+                    and not isinstance(pushed, CountCycleOp) \
+                    and not self._pushdown_wins(pushed):
+                # count-pushdown vs cascade is a MODEL choice now: a
+                # hyper-selective seed on a huge graph keeps the join
+                # cascade (tiny padded frontiers beat a full-graph SpMV)
+                pushed = None
             return pushed if pushed is not None else default
         if isinstance(op, L.OrderBy):
             parent = self.plan_op(op.parent)
@@ -399,6 +411,21 @@ class RelationalPlanner:
         if isinstance(op, L.EmptyRecords):
             return R.StartOp(ctx)
         raise RelationalPlanningError(f"cannot plan {type(op).__name__}")
+
+    def _pushdown_wins(self, pushed) -> bool:
+        """Price the matched count chain both ways (relational/cost.py
+        ``count_pushdown_wins``) — SpMV touches every edge once, the
+        cascade the padded expanded frontiers."""
+        model = self.cost_model
+        seed = pushed.seed
+        try:
+            return model.count_pushdown_wins(
+                seed.labels, model.selectivity(seed.preds, seed.labels),
+                [(h.rel_types, h.direction, h.target.labels,
+                  model.selectivity(h.target.preds, h.target.labels))
+                 for h in pushed.hops])
+        except Exception:  # pragma: no cover — pricing must not fail
+            return True
 
     # -- branch-scoped graph context ----------------------------------------
 

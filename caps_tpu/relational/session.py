@@ -303,7 +303,7 @@ class RelationalCypherResult(CypherResult):
 
     def explain(self) -> str:
         parts = []
-        for phase in ("ir", "logical", "relational", "profile"):
+        for phase in ("ir", "logical", "relational", "cost", "profile"):
             if phase in self.plans:
                 parts.append(f"=== {phase.upper()} ===\n{self.plans[phase]}")
         return "\n\n".join(parts)
@@ -325,10 +325,27 @@ class RelationalCypherSession(CypherSession):
         self.tracer = obs.Tracer(enabled=self.config.trace)
         # Observed per-operator statistics (obs/telemetry.py): every
         # execution folds its op_metrics entries in, keyed by
-        # (plan family, operator id) — the substrate the cost-based
-        # planner (ROADMAP item 4) reads.  Fused-replay aware for free:
-        # the entries recorded are the same ones PROFILE annotates.
-        self.op_stats = obs.OpStatsStore(registry=self.metrics_registry)
+        # (plan family, operator id) — the calibration substrate the
+        # cost-based planner (relational/cost.py) reads, and the
+        # model-divergence detector that triggers re-planning.  Fused-
+        # replay aware for free: the entries recorded are the same ones
+        # PROFILE annotates.
+        self.op_stats = obs.OpStatsStore(
+            registry=self.metrics_registry,
+            replan_threshold=max(1, self.config.replan_threshold or 1),
+            # late-binding: the lattice is constructed below; divergence
+            # only counts model error big enough to change the padded
+            # launch bucket (and fused-replay "rows" ARE served sizes)
+            bucket_fn=lambda n: self.shape_lattice.bucket(n))
+        # Divergence-triggered re-planning (ROADMAP item 3): families
+        # whose executions keep diverging from the MODEL estimate
+        # retire their cached plans (plan_cache.evict_family — the
+        # quarantine path) and re-plan with calibrated statistics.
+        # Listeners (the serving tier) observe structured replan.*
+        # events; the pending set marks families whose NEXT cold plan
+        # is the re-plan completion.
+        self.replan_listeners: List[Any] = []
+        self._replanned_pending: set = set()
         # Compile telemetry (obs/compile.py): every compile boundary —
         # the cold plan phase here, fused record runs on the TPU
         # backend, count-pushdown / dist-join program builds — charges
@@ -520,23 +537,53 @@ class RelationalCypherSession(CypherSession):
                 {"kind": c["kind"], "seconds": round(c["seconds"], 9),
                  "recompile": c["recompile"]} for c in charges]
 
+    def _make_cost_model(self, graph: RelationalCypherGraph,
+                         family: Optional[str] = None):
+        """One query's cost model (relational/cost.py): the graph's
+        ingest-time statistics sketch + the session shape lattice +
+        observed-actuals calibration for ``family``.  None with the
+        model disabled (EngineConfig.use_cost_model=False — the
+        heuristic-only baseline bench.py plan mode compares against)."""
+        if not self.config.use_cost_model:
+            return None
+        from caps_tpu.relational.cost import CostModel
+        from caps_tpu.relational.stats import graph_statistics
+        return CostModel(graph_statistics(graph),
+                         lattice=self.shape_lattice,
+                         op_stats=self.op_stats,
+                         compile_ledger=self.compile_ledger,
+                         config=self.config, family=family,
+                         registry=self.metrics_registry)
+
     def _plan_ir(self, graph: RelationalCypherGraph, ir,
-                 plan_params, params: Dict[str, Any]):
+                 plan_params, params: Dict[str, Any],
+                 family: Optional[str] = None):
         """Logical planning + optimization + relational planning for one
         (non-catalog) IR statement.  The ONE planning pipeline shared by
         the execute path, EXPLAIN, and CATALOG CREATE GRAPH — so the
-        plan EXPLAIN renders is by construction the plan that executes.
-        Returns (logical, context, rel_planner, root, t_logical_done)."""
+        plan EXPLAIN renders is by construction the plan that executes,
+        and the cost model's decisions (chain orientation, physical
+        strategy, per-operator estimates) are identical in both.
+        Returns (logical, context, rel_planner, root, t_logical_done);
+        the model rides ``rel_planner.cost_model``."""
+        model = self._make_cost_model(graph, family)
         with self.tracer.span("logical", kind="phase"):
             logical = LogicalPlanner(graph.schema, self._schema_resolver,
                                      plan_params).process(ir)
-            logical = LogicalOptimizer().process(logical)
+            logical = LogicalOptimizer(model).process(logical)
         t3 = clock.now()
         with self.tracer.span("relational", kind="phase"):
             context = R.RelationalRuntimeContext(self, params)
             rel_planner = RelationalPlanner(context, graph,
-                                            self._graph_resolver)
+                                            self._graph_resolver,
+                                            cost_model=model)
             root = rel_planner.process(logical)
+        if model is not None:
+            from caps_tpu.relational.cost import annotate_plan
+            try:
+                rel_planner.cost_summary = annotate_plan(root, model)
+            except Exception:  # pragma: no cover — pricing must not fail
+                rel_planner.cost_summary = None
         return logical, context, rel_planner, root, t3
 
     @contextlib.contextmanager
@@ -598,10 +645,16 @@ class RelationalCypherSession(CypherSession):
                            "rows": 0}
                 return RelationalCypherResult(plans=plans, metrics=metrics)
             inner = ir.inner if isinstance(ir, B.CreateGraphStatement) else ir
-            logical, _context, _planner, root, _t3 = self._plan_ir(
-                graph, inner, plan_params, params)
+            logical, _context, planner, root, _t3 = self._plan_ir(
+                graph, inner, plan_params, params,
+                family=normalize_query(query))
             plans["logical"] = logical.pretty()
             plans["relational"] = root.pretty()
+            summary = getattr(planner, "cost_summary", None)
+            if summary and summary.get("decisions"):
+                # estimated-vs-chosen: the model's decision log rides
+                # EXPLAIN next to the annotated operator tree
+                plans["cost"] = planner.cost_model.render_decisions()
         metrics = {"mode": "explain", "plan_s": clock.now() - t0, "rows": 0}
         return RelationalCypherResult(plans=plans, metrics=metrics)
 
@@ -770,8 +823,10 @@ class RelationalCypherSession(CypherSession):
                 self._catalog.delete(ir.qgn)
                 return RelationalCypherResult()
 
+            family = cache_key[0] if cache_key is not None \
+                else normalize_query(query)
             logical, context, rel_planner, root, t3 = self._plan_ir(
-                graph, ir, plan_params, params)
+                graph, ir, plan_params, params, family=family)
         checkpoint("plan")
         t4 = clock.now()
         # Compile ledger (obs/compile.py): the cold plan phase is a
@@ -783,6 +838,21 @@ class RelationalCypherSession(CypherSession):
 
         plans = {"ir": ir.pretty(), "logical": logical.pretty(),
                  "relational": root.pretty()}
+        cost_summary = getattr(rel_planner, "cost_summary", None)
+        if cost_summary and cost_summary.get("decisions"):
+            plans["cost"] = rel_planner.cost_model.render_decisions()
+        if family in self._replanned_pending:
+            # this cold plan IS the divergence-triggered re-plan: its
+            # planning seconds were charged to the compile ledger above
+            # (the event log's compile.charged accounts them), and the
+            # new plan's estimates are calibrated from observed actuals
+            self._replanned_pending.discard(family)
+            self.metrics_registry.counter("replan.completed").inc()
+            self._notify_replan("replan.completed", {
+                "family": family, "plan_s": t4 - t0,
+                "root_est_rows": (cost_summary or {}).get("root_est_rows"),
+                "decisions": (cost_summary or {}).get("decisions"),
+            })
         if self.config.print_ir:
             print(plans["ir"])
         if self.config.print_logical_plan:
@@ -825,9 +895,8 @@ class RelationalCypherSession(CypherSession):
         # observed-statistics fold: the plan family is the cache key's
         # normalized query text (computed lazily when the cache was
         # bypassed — uncacheable graph, degraded run, cache off)
-        self.op_stats.record(
-            cache_key[0] if cache_key is not None else
-            normalize_query(query), context.op_metrics)
+        self.op_stats.record(family, context.op_metrics)
+        self._maybe_replan()
         if self._profiling:
             # snapshot per-operator measurements into plain dicts BEFORE
             # the cache store resets the tree (obs/profile.py)
@@ -844,7 +913,8 @@ class RelationalCypherSession(CypherSession):
                 cold_phase_s=t4 - t0,
                 nbytes=_plan_nbytes(plans, root, context=context,
                                     catalog_deps=catalog_deps),
-                catalog_deps=tuple(sorted(catalog_deps.items())))
+                catalog_deps=tuple(sorted(catalog_deps.items())),
+                query_text=query)
             # Drop the memoized results before parking the tree in the
             # cache: the records object holds the (header, table) refs,
             # so a cached plan retains no tables between executions.
@@ -920,9 +990,62 @@ class RelationalCypherSession(CypherSession):
         self.op_stats.record(
             family if family is not None else normalize_query(query),
             op_metrics)
+        self._maybe_replan()
         result = RelationalCypherResult(records, None, plan.plans, metrics)
         result.profile = result_profile
         return result
+
+    # -- divergence-triggered re-planning (ROADMAP item 3) -------------------
+
+    def _maybe_replan(self) -> None:
+        """Retire every plan family whose executions crossed the model-
+        divergence threshold (obs/telemetry.py OpStatsStore): its cached
+        plans evict through the quarantine path, the family is marked so
+        its next cold plan reports ``replan.completed``, and listeners
+        (serve/server.py wires the structured event log) observe
+        ``replan.triggered`` — the end-to-end feedback loop."""
+        if not self.config.use_cost_model \
+                or (self.config.replan_threshold or 0) <= 0:
+            return
+        for family in self.op_stats.take_replan_candidates():
+            dropped = self.plan_cache.evict_family(family)
+            # retire the fused recordings with the plans: the re-planned
+            # tree may have a different shape (re-rooted chain, changed
+            # physical strategy) and replaying the OLD plan's recorded
+            # size stream against it would mis-gather — the same
+            # (plan quarantine + fused forget) pairing the serving
+            # tier's poisoned-plan ladder applies
+            fused = getattr(self, "fused", None)
+            if fused is not None:
+                seen = set()
+                for p in dropped:
+                    fk = (id(p.records_graph), p.query_text)
+                    if p.query_text and fk not in seen:
+                        seen.add(fk)
+                        try:
+                            fused.forget(p.records_graph, p.query_text)
+                        except Exception:  # pragma: no cover
+                            pass
+            # the family's observed history is deliberately KEPT: when
+            # the re-plan keeps the plan shape (the prior was wrong but
+            # nothing re-rooted), calibration replaces the mis-priced
+            # estimates with the observed means and the divergence
+            # stops — one re-plan, not churn.  If the re-plan CHANGES
+            # shape, cost.annotate_plan detects the operator-id
+            # mismatch and resets the history there (op ids do not
+            # transfer across plan shapes).
+            self.metrics_registry.counter("replan.triggered").inc()
+            if len(self._replanned_pending) < 64:
+                self._replanned_pending.add(family)
+            self._notify_replan("replan.triggered", {
+                "family": family, "quarantined_plans": len(dropped)})
+
+    def _notify_replan(self, event: str, info: Dict[str, Any]) -> None:
+        for listener in list(self.replan_listeners):
+            try:
+                listener(event, info)
+            except Exception:  # pragma: no cover — observers must not fail
+                pass
 
     # -- update statements (relational/updates.py) ---------------------------
 

@@ -385,10 +385,24 @@ class GraphSnapshot(RelationalCypherGraph):
         self._schema = schema
         self._node_lookup_cache: Optional[Mapping] = None
         self._rel_lookup_cache: Optional[Mapping] = None
+        self._statistics_cache = None
 
     @property
     def schema(self) -> Schema:
         return self._schema
+
+    def statistics(self):
+        """The base sketch refreshed with this snapshot's delta counts
+        (relational/stats.py ``fold_delta``) — commits and compactions
+        keep the cost model's cardinalities current without a full
+        host recompute (the delta is bounded by compaction, so the
+        fold's distortion is too)."""
+        if self._statistics_cache is None:
+            from caps_tpu.relational.stats import fold_delta
+            self._statistics_cache = fold_delta(
+                self.base.statistics(), self.state,
+                version=self.snapshot_version)
+        return self._statistics_cache
 
     # -- lookups (materialization) -------------------------------------
 
@@ -602,6 +616,12 @@ class VersionedGraph(RelationalCypherGraph):
 
     def rel_lookup(self):
         return self._current.rel_lookup()
+
+    def statistics(self):
+        """The CURRENT snapshot's refreshed sketch — commits publish a
+        new snapshot, whose fold over the base keeps the cost model's
+        cardinalities live across writes."""
+        return self._current.statistics()
 
     # -- write surface -------------------------------------------------
 

@@ -267,6 +267,15 @@ class QueryServer:
         self.event_log = EventLog(capacity=self.config.event_log_capacity,
                                   registry=registry,
                                   path=self.config.event_log_path)
+        #: divergence-triggered re-planning (relational/session.py
+        #: ``_maybe_replan``): the session retires a cached family whose
+        #: executions keep diverging from the cost model's estimates;
+        #: this listener lands the ``replan.*`` transitions in the
+        #: structured event log so the loop is observable end-to-end
+        #: (the re-plan's compile charge follows as ``compile.charged``)
+        listeners = getattr(session, "replan_listeners", None)
+        if listeners is not None:
+            listeners.append(self._on_replan)
         #: slow-query log: over-threshold requests captured with plan
         #: text, per-op stats, and the resource ledger (None = disabled)
         self.slow_log = None
@@ -435,6 +444,9 @@ class QueryServer:
         inflating ``mem.tracked_graph_bytes``."""
         if self.warmer is not None:
             self.warmer.finalize()
+        listeners = getattr(self.session, "replan_listeners", None)
+        if listeners is not None and self._on_replan in listeners:
+            listeners.remove(self._on_replan)
         self.telemetry.close()
         self.event_log.close()
         ledger = getattr(self.session, "memory_ledger", None)
@@ -1235,6 +1247,17 @@ class QueryServer:
                 family=self._family_label(req),
                 seconds=round(compile_s, 6),
                 snapshot_version=req.handle.info.get("snapshot_version"))
+
+    def _on_replan(self, event: str, info: Dict[str, Any]) -> None:
+        """Session re-plan transition → structured event (no request to
+        correlate: the trigger is an aggregate over executions, not one
+        request).  ``replan.triggered`` carries the quarantined-plan
+        count; ``replan.completed`` the re-plan seconds and the new
+        plan's calibrated root estimate."""
+        fields = {k: v for k, v in info.items() if k != "family"}
+        self.event_log.emit(event, request_id=None,
+                            family=str(info.get("family"))[:120],
+                            **fields)
 
     def _compaction_failed(self, ex: BaseException) -> None:
         """Compaction-failure incident hook (serve/compaction.py): flight

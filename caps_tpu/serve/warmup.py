@@ -178,8 +178,19 @@ class ServerWarmup:
         return []
 
     def _seed(self, payload) -> int:
-        """Pre-execution seeding: lattice boundaries + fused streams."""
+        """Pre-execution seeding: statistics prior + lattice boundaries
+        + fused streams."""
         session = self.server.session
+        if payload is not None and payload.get("stats"):
+            # the load half of collect_warm_state's ``stats`` field:
+            # price this process's first plans (the warmup runs
+            # themselves) from the previous process's observed sketch
+            # instead of paying the host recompute on the serving path
+            graph = self.server._default_graph
+            if getattr(graph, "graph_is_versioned", False):
+                graph = graph.current()
+            if hasattr(graph, "seed_statistics"):
+                graph.seed_statistics(payload["stats"])
         if self.config.seed_shape_buckets:
             if payload is not None:
                 session.shape_lattice.seed(

@@ -34,6 +34,13 @@ This module provides them:
   and writes never see it);
 * :func:`corrupt_shard` — silent data damage on one shard (digest /
   parity detection tests);
+* :func:`stale_statistics` — distort one graph's ingest-time
+  statistics sketch (relational/stats.py) by a scale factor, the
+  deterministic "stats-violating workload": the cost model prices
+  plans from the distorted prior while executions observe the true
+  cardinalities, so model divergence → quarantine → re-planning
+  (relational/session.py ``_maybe_replan``) can be practiced
+  end-to-end (tests/test_cost.py);
 * :class:`FaultPlan` — compose any of the above into one context
   manager.
 
@@ -576,6 +583,48 @@ def corrupt_shard(session, shard: int = 0, flip_bits: int = 1):
             f"({counts['skipped']} column(s) skipped) — the fault "
             "test would pass vacuously; ingest a divisible-row, "
             "non-bool column inside the block")
+
+
+@contextlib.contextmanager
+def stale_statistics(graph, scale: float = 0.001):
+    """While active, ``graph`` reports a statistics sketch whose node
+    and relationship cardinalities are scaled by ``scale`` — the
+    deterministic stats-violating workload.  The cost model
+    (relational/cost.py) prices plans from the distorted prior while
+    executions observe the TRUE cardinalities, so ``opstats``
+    divergence fires on real model error and the divergence →
+    quarantine → re-plan loop can be asserted end-to-end.  Exiting
+    restores the honest sketch (the "updated statistics" a re-plan
+    prices with).  Statistics are advisory by contract: results must
+    stay exact throughout.
+
+    Works on any graph exposing ``statistics()`` (ScanGraph,
+    GraphSnapshot, VersionedGraph); raises for graphs without a sketch
+    — a fault test that distorts nothing must fail loudly."""
+    import dataclasses as _dc
+
+    from caps_tpu.relational.stats import GraphStatistics
+
+    real = graph.statistics()
+    if not isinstance(real, GraphStatistics) or not real.total_nodes:
+        raise ValueError("stale_statistics needs a graph with a "
+                         "non-empty statistics sketch")
+    scale = float(scale)
+    distorted = GraphStatistics(
+        {combo: max(1, int(n * scale))
+         for combo, n in real.node_combos.items()},
+        {t: _dc.replace(r, rows=max(1, int(r.rows * scale)))
+         for t, r in real.rels.items()},
+        real.property_distinct, version=real.version)
+    _count_injection("stale_statistics")
+    # instance attribute shadows the class method; VersionedGraph
+    # delegates to its current snapshot, so the shadow covers every
+    # snapshot resolved while the fault is active
+    graph.statistics = lambda: distorted
+    try:
+        yield distorted
+    finally:
+        del graph.statistics
 
 
 class FaultPlan:

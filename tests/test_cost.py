@@ -1,0 +1,501 @@
+"""Cost-based planning (relational/stats.py + relational/cost.py,
+ROADMAP item 3): ingest-time cardinality/degree/skew sketches, the
+tensor-path cost model that prices plans in padded-bucket device terms,
+cost-ranked join-order enumeration, model-chosen physical strategies,
+and the divergence → quarantine → re-plan feedback loop.
+
+Correctness contract throughout: statistics are ADVISORY — a distorted
+sketch may mis-price a plan, it must never change results.  Every test
+that exercises a model decision asserts exact parity against a
+model-blind oracle.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from caps_tpu.backends.local.session import LocalCypherSession
+from caps_tpu.backends.tpu.session import TPUCypherSession
+from caps_tpu.okapi.config import EngineConfig
+from caps_tpu.relational.cost import (
+    CostModel, ROW_BYTES, choose_dist_strategy,
+)
+from caps_tpu.relational.stats import (
+    GraphStatistics, _sketch, graph_statistics,
+)
+from caps_tpu.relational.shapes import ShapeBucketLattice
+from caps_tpu.obs.telemetry import OpStatsStore
+from caps_tpu.serve.server import QueryServer, ServerConfig
+from caps_tpu.testing import faults
+from caps_tpu.testing.factory import create_graph
+from tests.util import make_graph
+
+
+# -- graph builders ----------------------------------------------------------
+
+
+def _skewed_graph(session, n_person=1500, n_city=30, seed=7):
+    """Many Persons, few Cities, LIVES_IN edges: a chain whose cheap
+    root is the City end (selective eq predicate over few rows)."""
+    rng = np.random.RandomState(seed)
+    return make_graph(
+        session,
+        {("Person",): [{"_id": i, "name": f"p{i}"} for i in range(n_person)],
+         ("City",): [{"_id": n_person + i, "name": f"c{i}"}
+                     for i in range(n_city)]},
+        {"LIVES_IN": [(i, n_person + int(rng.randint(0, n_city)), {})
+                      for i in range(n_person)]})
+
+
+CHAIN_Q = ("MATCH (a:Person)-[:LIVES_IN]->(c:City) WHERE c.name = $city "
+           "RETURN a.name AS n")
+
+
+def _rows(result, key="n"):
+    return sorted(m[key] for m in result.records.to_maps())
+
+
+def _ops(result):
+    return [m["op"] for m in result.metrics["operators"]]
+
+
+# -- statistics sketches -----------------------------------------------------
+
+
+def test_degree_sketch():
+    keys = np.array([0] * 40 + [1, 2, 3, 4] * 2, dtype=np.int64)
+    sk = _sketch(keys)
+    assert sk.rows == 48 and sk.distinct == 5
+    assert sk.max == 40
+    assert sk.skew == pytest.approx(40 / (48 / 5))
+    # node 0 is the lone heavy hitter (> 4x the mean degree of 9.6)
+    assert sk.hot_keys == ((0, 40),)
+
+
+def test_graph_statistics_lookups_and_caching():
+    session = TPUCypherSession()
+    g = _skewed_graph(session, n_person=200, n_city=10)
+    stats = graph_statistics(g)
+    assert stats.node_cardinality(["Person"]) == 200
+    assert stats.node_cardinality(["City"]) == 10
+    assert stats.node_cardinality() == 210
+    assert stats.rel_cardinality(["LIVES_IN"]) == 200
+    assert stats.rel_cardinality(["NOPE"]) == 0
+    assert stats.label_fraction(["City"]) == pytest.approx(10 / 210)
+    # names are unique per label set -> distinct == cardinality
+    assert stats.eq_distinct(["Person"], "name") == 200
+    assert stats.eq_distinct(["Person"], "nope") is None
+    assert stats.summary()["rel_types"] == ["LIVES_IN"]
+    # lazily computed once, cached on the graph
+    snap = session.metrics_snapshot()
+    assert snap["stats.computed"] == 1
+    assert g.statistics() is stats
+    assert session.metrics_snapshot()["stats.computed"] == 1
+
+
+def test_stats_payload_roundtrip():
+    session = TPUCypherSession()
+    g = _skewed_graph(session, n_person=100, n_city=8)
+    stats = graph_statistics(g)
+    back = GraphStatistics.from_payload(stats.to_payload())
+    assert back.node_cardinality(["Person"]) == 100
+    assert back.rel_cardinality(["LIVES_IN"]) == 100
+    assert back.eq_distinct(["City"], "name") == 8
+    assert back.rels["LIVES_IN"].out.max == stats.rels["LIVES_IN"].out.max
+    # the store is a hint, never an authority: malformed -> None
+    assert GraphStatistics.from_payload({"node_combos": 7}) is None
+    assert GraphStatistics.from_payload(
+        {"rels": {"K": {"rows": "NaN-ish", "out": []}}}) is None
+
+
+def test_seed_statistics_adopts_persisted_prior():
+    """The plan store's ``stats`` field has a LOAD half: a fresh
+    graph adopts the persisted sketch as its prior (no host
+    recompute), a live sketch always wins, and malformed payloads are
+    hints — refused, never raised."""
+    s1 = TPUCypherSession()
+    g1 = _skewed_graph(s1, n_person=100, n_city=8)
+    payload = g1.statistics().to_payload()
+
+    s2 = TPUCypherSession()
+    g2 = _skewed_graph(s2, n_person=10, n_city=2)
+    assert g2.seed_statistics(payload) is True
+    # the prior IS the previous process's sketch, not this graph's
+    assert g2.statistics().node_cardinality(["Person"]) == 100
+    m = s2.metrics_snapshot()
+    assert m.get("stats.seeded", 0) == 1
+    assert m.get("stats.computed", 0) == 0
+
+    # a graph that already computed refuses the seed
+    s3 = TPUCypherSession()
+    g3 = _skewed_graph(s3, n_person=10, n_city=2)
+    g3.statistics()
+    assert g3.seed_statistics(payload) is False
+    assert g3.statistics().node_cardinality(["Person"]) == 10
+
+    # malformed / empty payloads are refused
+    s4 = TPUCypherSession()
+    g4 = _skewed_graph(s4, n_person=10, n_city=2)
+    assert g4.seed_statistics({"node_combos": 7}) is False
+    assert g4.seed_statistics({}) is False
+
+
+def test_fold_delta_refreshes_across_commits():
+    from caps_tpu.relational.updates import versioned
+    session = TPUCypherSession()
+    vg = versioned(session, create_graph(session, """
+        CREATE (a:P {name: 'x'}), (b:P {name: 'y'}), (a)-[:K]->(b)
+    """))
+    base = vg.statistics()
+    assert base.node_cardinality(["P"]) == 2
+    vg.cypher("CREATE (:P {name: 'z'})")
+    refreshed = vg.statistics()
+    assert refreshed.node_cardinality(["P"]) == 3
+    assert refreshed.version > base.version
+    vg.cypher("MATCH (n:P {name: 'z'}) DELETE n")
+    assert vg.statistics().node_cardinality(["P"]) == 2
+
+
+# -- the cost model ----------------------------------------------------------
+
+
+class _Cfg:
+    broadcast_join_threshold = 4096
+    join_hot_factor = 4.0
+
+
+def test_choose_dist_strategy_matrix():
+    cfg = _Cfg()
+    # build side under the broadcast prior -> broadcast
+    s, info = choose_dist_strategy(100_000, 1000, 8, cfg)
+    assert s == "broadcast" and info["reason"] == "build<=threshold"
+    # big balanced sides, no skew -> radix exchange
+    s, info = choose_dist_strategy(100_000, 100_000, 8, cfg)
+    assert s == "radix" and info["reason"] == "exchange"
+    # sketch-predicted skew at/beyond the hot factor -> planned salt
+    s, info = choose_dist_strategy(100_000, 100_000, 8, cfg, skew=6.0)
+    assert s == "salted" and info["reason"] == "skew_sketch"
+    # huge probe vs modest build: decisively cheaper on the wire
+    s, info = choose_dist_strategy(10_000_000, 5000, 8, cfg)
+    assert s == "broadcast" and info["reason"] == "wire_model"
+    # threshold <= 0 disables broadcasting entirely
+    cfg.broadcast_join_threshold = 0
+    s, _ = choose_dist_strategy(100_000, 10, 8, cfg)
+    assert s == "radix"
+
+
+def test_device_cost_prices_padded_buckets():
+    lattice = ShapeBucketLattice()
+    lattice.seed([1000, 5000])
+    model = CostModel(lattice=lattice)
+    bounds = lattice.boundaries()
+    assert model.padded_rows(3) == bounds[0]
+    assert model.device_cost(3) == bounds[0] * ROW_BYTES
+    # 1000 pads to its pow2 ceiling, not itself
+    assert model.padded_rows(1000) == 1024
+    # beyond every seen boundary: the compile-risk surcharge prices the
+    # cold-cliff in (a brand-new bucket is a brand-new XLA program)
+    beyond = bounds[-1] * 2
+    assert model.device_cost(beyond) == \
+        model.padded_rows(beyond) * ROW_BYTES * 2.0
+
+
+def test_calibrated_rows_prefers_observed_history():
+    store = OpStatsStore()
+    fam = "FAM"
+    entries = [{"op_id": 1, "op": "Scan", "rows": 500, "seconds": 0.0}]
+    model = CostModel(op_stats=store, family=fam)
+    est, src = model.calibrated_rows(1, "Scan", 7.0)
+    assert (est, src) == (7.0, "model")  # no history yet
+    store.record(fam, entries)
+    store.record(fam, entries)
+    model = CostModel(op_stats=store, family=fam)  # fresh snapshot
+    est, src = model.calibrated_rows(1, "Scan", 7.0)
+    assert (est, src) == (500.0, "observed")
+
+
+def test_opstats_model_divergence_is_bucket_aware():
+    lattice = ShapeBucketLattice()
+    store = OpStatsStore(replan_threshold=2, bucket_fn=lattice.bucket)
+    fam = "FAM"
+
+    def rec(rows, est):
+        store.record(fam, [{"op_id": 1, "op": "Scan", "rows": rows,
+                            "est_rows": est, "seconds": 0.0}])
+
+    # >4x error but same padded bucket: no device-cost consequence
+    rec(200, 10)
+    assert store.summary()["divergences"] == 0
+    # >4x error, different bucket, above the floor: model divergence
+    rec(5000, 100)
+    assert store.summary()["divergences"] == 1
+    assert store.take_replan_candidates() == []  # threshold is 2
+    rec(5000, 100)
+    assert store.take_replan_candidates() == [fam]
+    assert store.take_replan_candidates() == []  # handed off exactly once
+    # under the floor never diverges, bucket change or not
+    store2 = OpStatsStore(divergence_floor=256, bucket_fn=lattice.bucket)
+    store2.record(fam, [{"op_id": 1, "op": "Scan", "rows": 100,
+                         "est_rows": 1, "seconds": 0.0}])
+    assert store2.summary()["divergences"] == 0
+    # estimate-vs-actual surfaced per entry
+    st = store.stats(fam)["1:Scan"]
+    assert st["est_rows"] == 100 and st["est_err"] > 4
+    assert store.summary()["estimated_operators"] == 1
+
+
+# -- cost-ranked join ordering ----------------------------------------------
+
+
+def test_chain_reroots_at_selective_far_end():
+    oracle = _skewed_graph(
+        TPUCypherSession(config=EngineConfig(use_cost_model=False)))
+    session = TPUCypherSession()
+    g = _skewed_graph(session)
+    want = _rows(oracle.cypher(CHAIN_Q, {"city": "c3"}))
+    res = g.cypher(CHAIN_Q, {"city": "c3"})
+    assert _rows(res) == want
+    # the model re-rooted the chain: the City scan (selective far end)
+    # seeds, the Person scan joins in last
+    plan = res.plans["relational"]
+    assert plan.index("Scan(c: CTNode(City))") \
+        < plan.index("Scan(a: CTNode(Person))")
+    assert session.metrics_snapshot()["cost.reorders"] == 1
+    # EXPLAIN carries per-operator estimates and the decision log
+    exp = g.cypher("EXPLAIN " + CHAIN_Q, {"city": "c3"}).explain()
+    assert "~rows=" in exp and "(model)" in exp
+    assert "join_order:" in exp and "chosen=reversed" in exp
+
+
+def test_reorder_hysteresis_keeps_symmetric_chains_forward():
+    session = TPUCypherSession()
+    rng = np.random.RandomState(11)
+    g = make_graph(
+        session,
+        {("P",): [{"_id": i, "name": f"n{i}"} for i in range(300)]},
+        {"K": [(int(rng.randint(300)), int(rng.randint(300)), {})
+               for _ in range(600)]})
+    # same label both ends, no predicate: both orientations price the
+    # same; the margin keeps the forward (written) order — no churn on
+    # ties
+    res = g.cypher("EXPLAIN MATCH (a:P)-[:K]->(b:P) RETURN a.name AS n")
+    assert "chosen=forward" in res.plans["cost"]
+    assert session.metrics_snapshot().get("cost.reorders", 0) == 0
+
+
+def test_cost_model_off_restores_heuristic_planning():
+    session = TPUCypherSession(config=EngineConfig(use_cost_model=False))
+    g = _skewed_graph(session)
+    res = g.cypher("EXPLAIN " + CHAIN_Q, {"city": "c3"})
+    assert "cost" not in res.plans
+    assert "~rows=" not in res.plans["relational"]
+    # written order preserved: Person scans first
+    plan = res.plans["relational"]
+    assert plan.index("Scan(a: CTNode(Person))") \
+        < plan.index("Scan(c: CTNode(City))")
+
+
+# -- model-chosen physical strategies ----------------------------------------
+
+
+def test_count_pushdown_stays_fused_when_spmv_wins():
+    session = TPUCypherSession()
+    g = _skewed_graph(session, n_person=200, n_city=10)
+    res = g.cypher("MATCH (a:Person)-[:LIVES_IN]->(c:City) "
+                   "RETURN count(*) AS c")
+    assert "CountPattern" in _ops(res)
+    assert res.records.to_maps()[0]["c"] == 200
+
+
+def test_count_pushdown_boundary_prices_launches():
+    """The decision boundary, on synthetic statistics: the fused SpMV
+    is ONE program over every edge, the cascade is 1 + 2*hops launches
+    over tiny padded frontiers.  Small graph -> the launch overhead
+    keeps the SpMV; huge graph + unique seed -> the edge bytes dwarf
+    the cascade's launches and the model routes around the SpMV."""
+    from caps_tpu.ir.pattern import Direction
+    from caps_tpu.relational.stats import DegreeSketch, RelStats
+
+    def stats(n, e):
+        return GraphStatistics(
+            {frozenset(["P"]): n},
+            {"K": RelStats("K", e, DegreeSketch(rows=e, distinct=n,
+                                                mean=e / n))},
+            {(frozenset(["P"]), "name"): n})
+
+    lattice = ShapeBucketLattice()
+    hops = [(("K",), Direction.OUTGOING, (), 1.0)]
+    small = CostModel(stats(5000, 5000), lattice=lattice)
+    assert small.count_pushdown_wins(["P"], 1 / 5000, hops)
+    huge = CostModel(stats(2_000_000, 2_000_000), lattice=lattice)
+    assert not huge.count_pushdown_wins(["P"], 1 / 2_000_000, hops)
+
+
+def test_count_pushdown_routes_to_cascade_on_selective_seed():
+    """End to end: a hyper-selective seed (unique names) on a chain the
+    statistics sketch prices as huge — the padded cascade frontiers are
+    tiny, the SpMV would touch millions of edges, the planner keeps the
+    join cascade.  Counts stay exact (statistics are advisory)."""
+    def build(sess):
+        rng = np.random.RandomState(3)
+        return make_graph(
+            sess,
+            {("P",): [{"_id": i, "name": f"u{i}"} for i in range(5000)]},
+            {"K": [(int(rng.randint(5000)), int(rng.randint(5000)), {})
+                   for _ in range(5000)]})
+    q = "MATCH (a:P)-[:K]->(b) WHERE a.name = $u RETURN count(*) AS c"
+    oracle = build(LocalCypherSession())
+    session = TPUCypherSession()
+    g = build(session)
+    with faults.stale_statistics(g, scale=400):  # sketch says 2M edges
+        res = g.cypher(q, {"u": "u17"})
+        assert "CountPattern" not in _ops(res), res.plans["relational"]
+        assert res.records.to_maps() == \
+            oracle.cypher(q, {"u": "u17"}).records.to_maps()
+        # the decision is in the EXPLAIN cost log
+        exp = g.cypher("EXPLAIN " + q, {"u": "u17"})
+        assert "count_strategy" in exp.plans["cost"]
+        assert "chosen=cascade" in exp.plans["cost"]
+    # honest (small) statistics: the SpMV wins, counts agree
+    session2 = TPUCypherSession()
+    g2 = build(session2)
+    res2 = g2.cypher(q, {"u": "u17"})
+    assert "CountPattern" in _ops(res2)
+    assert res2.records.to_maps() == res.records.to_maps()
+
+
+def test_sharded_explain_renders_dist_strategy():
+    """EXPLAIN on a sharded-path query renders the distribution
+    strategy (radix/salted/broadcast) the model would pick — visible
+    BEFORE execution, not only after."""
+    def build(sess):
+        rng = np.random.RandomState(5)
+        return make_graph(
+            sess,
+            {("P",): [{"_id": i, "v": int(rng.randint(0, 40))}
+                      for i in range(400)]},
+            {"T": [(int(rng.randint(400)), int(rng.randint(400)),
+                    {}) for _ in range(1500)]})
+    q = ("MATCH (a:P)-[r:T]->(b:P) WHERE a.v = 7 "
+         "RETURN b.v AS v, count(*) AS c ORDER BY v")
+    s1 = TPUCypherSession(config=EngineConfig(mesh_shape=(8,),
+                                              use_csr=False))
+    exp = build(s1).cypher("EXPLAIN " + q)
+    assert "dist=broadcast" in exp.plans["relational"]
+    assert "dist:" in exp.plans["cost"]
+    # broadcasting disabled: the same plan renders the exchange
+    s2 = TPUCypherSession(config=EngineConfig(
+        mesh_shape=(8,), use_csr=False, broadcast_join_threshold=0))
+    exp2 = build(s2).cypher("EXPLAIN " + q)
+    assert "dist=radix" in exp2.plans["relational"] \
+        or "dist=salted" in exp2.plans["relational"]
+
+
+# -- shape-keyed count_fused closures (the PR 10 residual) -------------------
+
+
+def test_count_fused_closures_key_on_param_shape():
+    """Unseen bindings of a seen shape stop charging ``count_fused``
+    compiles: the closure keys on the param shape signature, predicate
+    masks rebuild per binding as eager device args."""
+    def build(sess):
+        rng = np.random.RandomState(7)
+        return make_graph(
+            sess,
+            {("P",): [{"_id": i, "name": f"n{i % 13}"}
+                      for i in range(120)]},
+            {"K": [(int(rng.randint(120)), int(rng.randint(120)), {})
+                   for _ in range(500)]})
+    q = ("MATCH (a:P)-[:K]->(b)-[:K]->(c) WHERE a.name = $seed "
+         "RETURN count(*) AS c")
+    oracle = build(LocalCypherSession())
+    session = TPUCypherSession()
+    g = build(session)
+    charged = []
+    for seed in ("n5", "n3", "n7", "n5"):
+        res = g.cypher(q, {"seed": seed})
+        assert res.records.to_maps() == \
+            oracle.cypher(q, {"seed": seed}).records.to_maps(), seed
+        strat = [m for m in res.metrics["operators"]
+                 if m["op"] == "CountPattern"]
+        assert strat and strat[0]["strategy"] == "fused-spmv"
+        charged.append(res.metrics["compile_s_charged"])
+    # ONE compile for the family; every unseen binding replays free
+    assert charged[0] > 0
+    assert charged[1:] == [0.0, 0.0, 0.0]
+    assert session.metrics_snapshot()["compile.recompiles"] == 0
+
+
+# -- divergence -> quarantine -> re-plan, end to end -------------------------
+
+
+def test_replan_loop_end_to_end_through_server():
+    """The full feedback loop through QueryServer: a stats-violating
+    workload (distorted sketch via testing/faults.py) diverges the
+    model, the cached family retires through the quarantine path
+    (``replan.triggered`` + plan_cache.quarantined), the next execution
+    re-plans with the updated statistics and CHANGES strategy (the
+    chain re-roots), ``replan.completed`` carries the re-plan, its
+    compile seconds are charged — and results are exact throughout."""
+    oracle = _skewed_graph(
+        TPUCypherSession(config=EngineConfig(use_cost_model=False)))
+    want = {c: _rows(oracle.cypher(CHAIN_Q, {"city": c}))
+            for c in ("c3", "c5")}
+    session = TPUCypherSession()
+    g = _skewed_graph(session)
+    server = QueryServer(session, graph=g,
+                         config=ServerConfig(workers=2))
+    try:
+        with faults.stale_statistics(g, scale=0.001):
+            # the distorted prior prices everything under one bucket:
+            # the chain keeps its written (forward) order
+            plans = []
+            for c in ("c3", "c5"):  # replan_threshold executions
+                res = server.submit(CHAIN_Q, {"city": c}).result()
+                assert _rows(res) == want[c], c  # exact under the fault
+                plans.append(res.plans["relational"])
+            assert plans[0].index("Scan(a: CTNode(Person))") \
+                < plans[0].index("Scan(c: CTNode(City))")
+        # the second diverged execution crossed the threshold: the
+        # family was retired through the quarantine path
+        snap = session.metrics_snapshot()
+        assert snap["replan.triggered"] == 1
+        assert snap["plan_cache.quarantined"] >= 1
+        assert snap["opstats.divergences"] >= 2
+        # updated (honest) statistics: the re-plan re-roots the chain
+        res = server.submit(CHAIN_Q, {"city": "c3"}).result()
+        assert _rows(res) == want["c3"]
+        assert res.metrics["plan_cache"] == "miss"
+        assert res.metrics["compile_s_charged"] > 0  # the re-plan's cost
+        plan = res.plans["relational"]
+        assert plan.index("Scan(c: CTNode(City))") \
+            < plan.index("Scan(a: CTNode(Person))")
+        assert session.metrics_snapshot()["replan.completed"] == 1
+        # the loop is observable in the structured event log, in order
+        events = [e for e in server.event_log.records()
+                  if e["event"].startswith("replan.")]
+        assert [e["event"] for e in events] == ["replan.triggered",
+                                                "replan.completed"]
+        assert events[0]["quarantined_plans"] >= 1
+        assert events[1]["plan_s"] > 0
+        # estimate-vs-actual surfaced on the serving stats surface
+        opstats = server.health_report()["opstats"]
+        assert opstats["estimated_operators"] > 0
+        # the re-planned family serves warm again, no further churn
+        res = server.submit(CHAIN_Q, {"city": "c5"}).result()
+        assert _rows(res) == want["c5"]
+        assert res.metrics["plan_cache"] == "hit"
+        assert session.metrics_snapshot()["replan.triggered"] == 1
+    finally:
+        server.shutdown()
+
+
+def test_replan_disabled_never_retires_plans():
+    session = TPUCypherSession(config=EngineConfig(replan_threshold=0))
+    g = _skewed_graph(session, n_person=400, n_city=10)
+    with faults.stale_statistics(g, scale=0.001):
+        for c in ("c1", "c2", "c1", "c2"):
+            g.cypher(CHAIN_Q, {"city": c})
+    snap = session.metrics_snapshot()
+    assert snap.get("replan.triggered", 0) == 0
+    assert snap.get("plan_cache.quarantined", 0) == 0

@@ -444,7 +444,11 @@ def _mixed_soak(make_session, *, writers, readers, writes_each,
     vg = versioned(s, create_graph(s, "CREATE (:Seed {k:-1, v:-1})"))
     server = QueryServer(s, graph=vg, config=ServerConfig(
         workers=2, max_queue=4096,
-        retry=RetryPolicy(max_attempts=5, backoff_base_s=0.002,
+        # 8 attempts: the every-5 injector is PERMANENT and a commit
+        # places 3 columns, so adversarial thread phasing can land the
+        # same write on the abort boundary several attempts running —
+        # the retry budget must outlast the worst phase, not the mean
+        retry=RetryPolicy(max_attempts=8, backoff_base_s=0.002,
                           backoff_max_s=0.05),
         compaction_threshold_rows=compaction_threshold,
         compaction_interval_s=0.005))
