@@ -158,6 +158,14 @@ def _force_cpu():
     # Pallas kernels only *compile* on TPU; on CPU they run in the (slow)
     # interpreter, so the honest CPU-fallback number uses the jnp twins.
     os.environ.setdefault("CAPS_TPU_USE_PALLAS", "0")
+    # virtual CPU devices (same trick as tests/conftest.py) so meshed
+    # paths — the sharded cross-shard session of `serve --shards N` —
+    # exercise the real shard_map programs.  Only effective when jax
+    # has not initialized its backends yet (flag read at first use).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
     try:
@@ -1007,6 +1015,181 @@ def run_serve_devices_config(on_tpu: bool, devices_n: int):
     _emit()
 
 
+def run_serve_shards_config(on_tpu: bool, shards_n: int):
+    """Benchmark config 9: sharded serving (``serve --shards N``).
+
+    The capacity acceptance (ROADMAP item 2): the source graph lives in
+    HOST memory (built on the local oracle session — the snapshot base),
+    and the server fronts it with a shard group of N member devices
+    whose per-member page budget is the *simulated HBM budget* — sized
+    so the WHOLE graph is ~N× larger than any single member may hold
+    resident.  Phase A measures closed-loop QPS over a mixed
+    single-shard (partition-property equality → owning member) +
+    cross-shard (2-hop traversal → the group's mesh-sharded session)
+    workload, with paging gauges proving every member stayed within
+    budget.  Phase B kills one shard member mid-run
+    (``testing.faults.shard_loss``, bounded — the 'recovered device'):
+    value = availability, the fraction of requests resolving with
+    correct rows while the victim's group degrades, rebuilds from the
+    host slices, and reinstates; group health transitions and
+    ``telemetry_p99`` are reported from the server surfaces.
+    """
+    import threading as _th
+    import numpy as np
+    from caps_tpu.backends.local.session import LocalCypherSession
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.serve import (QueryServer, RetryPolicy, ServeError,
+                                ServerConfig)
+    from caps_tpu.serve.shards import ShardGroupConfig
+    from caps_tpu.testing.faults import shard_loss
+
+    _result.update({"metric": "sharded serve availability "
+                              "(no measurement completed)",
+                    "unit": "fraction", "value": 0.0})
+    rng = np.random.RandomState(42)
+    if on_tpu:
+        n_people, n_edges = 100_000, 500_000
+    else:
+        n_people, n_edges = 20_000, 100_000
+    n_people = int(os.environ.get("BENCH_N_PEOPLE", n_people))
+    n_edges = int(os.environ.get("BENCH_N_EDGES", n_edges))
+    shards_n = max(2, int(shards_n))
+    # the source graph lives on the HOST (local oracle session): device
+    # residency is owned entirely by the group's members
+    host_session = LocalCypherSession()
+    graph, src, dst, names = build_graph(host_session, n_people,
+                                         n_edges, 10, rng)
+    session = TPUCypherSession()
+
+    Q_NAME = ("MATCH (n:Person) WHERE n.name = $seed "
+              "RETURN count(*) AS c")
+    seeds = [f"p{i}" for i in (1, 7, 13)] + ["Alice"]
+    exp_name = {s: sum(1 for nm in names if nm == s) for s in seeds}
+    exp_cross = expected_paths(src, dst, names, seeds)
+
+    # simulated HBM budget: the whole graph is ~N× one member's budget
+    from caps_tpu.serve.shards import partition_graph
+    parts_probe = partition_graph(graph, shards_n * 3, "name")
+    total_bytes = sum(p.host_nbytes() for p in parts_probe)
+    # budget BELOW one member's fair share: the group must page cold
+    # partitions through host memory to serve the whole graph
+    budget = int(total_bytes / shards_n * 0.9) + 1
+    server = QueryServer(session, graph=graph, config=ServerConfig(
+        shards=shards_n, max_queue=4096, max_batch=8,
+        shard_config=ShardGroupConfig(
+            name="bench", partition_property="name",
+            partitions_per_member=3, page_budget_bytes=budget,
+            member_failure_threshold=2, member_cooldown_s=0.05),
+        breaker_threshold=1000,
+        retry=RetryPolicy(max_attempts=40, backoff_base_s=0.002,
+                          backoff_max_s=0.05)))
+    group = server.shard_groups[0]
+    assert group.health() == "healthy"
+
+    clients = 8
+    per_client = int(os.environ.get("BENCH_SERVE_REQS", "12"))
+    total = clients * per_client
+
+    def closed_loop():
+        latencies, outcomes = [], []
+
+        def client(i):
+            for j in range(per_client):
+                seed = seeds[(i + j) % len(seeds)]
+                try:
+                    if (i + j) % 3 == 0:     # cross-shard traversal
+                        h = server.submit(PARAM_QUERY, {"seed": seed})
+                        want = exp_cross[seed]
+                    else:                    # single-shard routed
+                        h = server.submit(Q_NAME, {"seed": seed})
+                        want = exp_name[seed]
+                    rows = h.rows(timeout=300)
+                    outcomes.append("ok" if rows[0]["c"] == want
+                                    else "wrong")
+                    latencies.append(h.info["latency_s"])
+                except ServeError as ex:
+                    outcomes.append(type(ex).__name__)
+                except Exception as ex:  # untyped = availability failure
+                    outcomes.append(f"UNTYPED:{type(ex).__name__}")
+        threads = [_th.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, outcomes, latencies
+
+    # -- phase A: capacity + QPS on the healthy group ------------------
+    closed_loop()  # warm every routed member's plan cache + compiles
+    elapsed, outcomes, lats = closed_loop()
+    ok = sum(1 for o in outcomes if o == "ok")
+    shard_stats = server.stats()["shards"][0]
+    paging = shard_stats["paging"]
+    resident_max = max(m["resident_bytes"]
+                       for m in shard_stats["members"])
+    telem = server.stats()["telemetry"]
+    _result.update({
+        "metric": f"sharded serve: {shards_n}-member group, graph "
+                  f"~{round(total_bytes / budget, 2)}x one member's "
+                  f"simulated HBM budget, 8-client closed loop "
+                  f"({n_people} nodes, {n_edges} edges, "
+                  f"{'tpu' if on_tpu else 'cpu-simulated-devices'})",
+        "qps": round(ok / elapsed, 1) if elapsed else 0.0,
+        "graph_host_bytes": int(total_bytes),
+        "member_budget_bytes": int(budget),
+        "graph_vs_budget_ratio": round(total_bytes / budget, 3),
+        "resident_bytes_max_member": int(resident_max),
+        "members_within_budget": bool(resident_max <= budget),
+        "paging_faults": paging["faults"],
+        "paging_spills": paging["spills"],
+        "paging_host_bytes": paging["host_bytes"],
+        "cross_shard_meshed": shard_stats["cross_shard_meshed"],
+        "requests_single": session.metrics_snapshot()
+        .get("shard.requests.single", 0),
+        "requests_cross": session.metrics_snapshot()
+        .get("shard.requests.cross", 0),
+        "telemetry_p99": (telem.get("latency") or {}).get("p99_s"),
+        **{f"healthy_{k}": v for k, v in _percentiles(lats).items()},
+    })
+
+    # -- phase B: one shard member killed mid-run ----------------------
+    if _remaining() > 20:
+        with shard_loss("bench", 0, n_times=8,
+                        op_name="Scan") as budget_inj:
+            elapsed, outcomes, lats = closed_loop()
+        ok = sum(1 for o in outcomes if o == "ok")
+        untyped = sum(1 for o in outcomes if o.startswith("UNTYPED"))
+        # let the background rebuild finish before reading final state
+        deadline = time.perf_counter() + 10
+        while server.stats()["shards"][0]["state"] != "healthy" \
+                and time.perf_counter() < deadline:
+            time.sleep(0.05)
+        shard_stats = server.stats()["shards"][0]
+        _result.update({
+            "value": round(ok / total, 4) if total else 0.0,
+            "metric": _result["metric"].replace(
+                "8-client closed loop",
+                "availability with 1 shard member killed mid-run, "
+                "8-client closed loop"),
+            "shard_loss_injected": budget_inj.injected,
+            "shard_loss_ok": ok,
+            "shard_loss_untyped_errors": untyped,
+            "shard_loss_qps": round(ok / elapsed, 1) if elapsed else 0.0,
+            "group_transitions": [t["state"] for t in
+                                  shard_stats["transitions"]],
+            "group_state_final": shard_stats["state"],
+            "victim_rebuilds": shard_stats["members"][0]["rebuilds"],
+            "victim_quarantines":
+                shard_stats["members"][0]["quarantines"],
+            "loss_telemetry_p99": (server.stats()["telemetry"]
+                                   .get("latency") or {}).get("p99_s"),
+            **{f"loss_{k}": v for k, v in _percentiles(lats).items()},
+        })
+    server.shutdown()
+    _emit()
+
+
 def run_faults_config(on_tpu: bool):
     """Benchmark config 6: the serving tier under injected faults
     (ISSUE 5 — failure containment).
@@ -1550,6 +1733,10 @@ def main():
             i = sys.argv.index("--devices")
             devices_n = int(sys.argv[i + 1]) if i + 1 < len(sys.argv) else 2
             return run_serve_devices_config(on_tpu, devices_n)
+        if "--shards" in sys.argv:
+            i = sys.argv.index("--shards")
+            shards_n = int(sys.argv[i + 1]) if i + 1 < len(sys.argv) else 2
+            return run_serve_shards_config(on_tpu, shards_n)
         return run_serve_config(on_tpu)
     if len(sys.argv) > 1 and sys.argv[1] == "faults":
         return run_faults_config(on_tpu)

@@ -41,7 +41,8 @@ BANNED_TIME_READS = frozenset({
 DEFAULT_SERVE_MODULES = frozenset({
     "__init__.py", "admission.py", "batcher.py", "breaker.py",
     "compaction.py", "deadline.py", "devices.py", "errors.py",
-    "failure.py", "request.py", "retry.py", "server.py", "warmup.py",
+    "failure.py", "request.py", "retry.py", "server.py", "shards.py",
+    "warmup.py",
 })
 
 
@@ -93,14 +94,14 @@ class AnalysisConfig:
     #: exception is a mutation violation
     exception_markers: frozenset = frozenset({
         "caps_failed_op", "caps_device_index", "caps_transient",
-        "caps_device_fault"})
+        "caps_device_fault", "caps_shard_member"})
     #: sanctioned first segments of dotted metric names
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
         "faults", "fused", "dist_join", "obs", "backend", "tracer",
         "updates", "compaction", "telemetry", "slo", "opstats",
         "compile", "mem", "slowlog", "warmup", "bucket", "planstore",
-        "cost", "stats", "replan"})
+        "cost", "stats", "replan", "shard", "paging"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)

@@ -14,7 +14,11 @@ always taken in one global order.  This pass:
 2. builds **acquisition edges** from ``with <lock>:`` nesting inside
    each function, plus one level of same-module / same-class call
    resolution (holding A while calling a neighbour that takes B is an
-   A->B edge);
+   A->B edge).  A foreign-attribute acquisition whose name is defined
+   as a lock on several classes (``member.lock`` behind the serve
+   tier's duck-typed replica/shard-group seam) resolves to EVERY
+   candidate — bounded may-alias, each alias keeps its edges, no edge
+   is fabricated between aliases of the one runtime lock;
 3. reports every **cycle** as a potential deadlock, and every lock
    acquired in a ``__del__`` or an ``atexit.register``-ed function
    (finalizer-time acquisition deadlocks interpreter shutdown).
@@ -149,22 +153,36 @@ def collect_locks(project: Project) -> _LockIndex:
     return index
 
 
-def _resolve_lock(expr: ast.AST, src: Source, cls_name: Optional[str],
-                  index: _LockIndex) -> Optional[str]:
-    """The lock id a ``with`` item / expression refers to, or None."""
+#: foreign-attribute may-alias bound: an attribute name defined as a
+#: lock on more than this many classes is too generic to resolve
+#: (e.g. ``x._lock``) — edges through it would be mostly noise
+_MAY_ALIAS_CAP = 3
+
+
+def _resolve_locks(expr: ast.AST, src: Source, cls_name: Optional[str],
+                   index: _LockIndex) -> Tuple[str, ...]:
+    """The lock ids a ``with`` item / expression may refer to (usually
+    exactly one; empty = not a tracked lock).  ``self.X`` and
+    module-level names resolve precisely.  A foreign attribute
+    (``replica.lock``) resolves to EVERY class defining that attribute
+    as a lock, up to :data:`_MAY_ALIAS_CAP` — duck-typed execution
+    seams (a ShardGroup standing in for a DeviceReplica behind one call
+    site) genuinely may-alias, and dropping the acquisition would
+    silently erase the serve tier's real nesting edges."""
     if isinstance(expr, ast.Name):
-        return index.module_level.get((src.rel, expr.id))
+        lid = index.module_level.get((src.rel, expr.id))
+        return (lid,) if lid is not None else ()
     if isinstance(expr, ast.Attribute):
         attr = expr.attr
         if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
                 and cls_name is not None:
             lid = index.class_attrs.get((src.rel, cls_name, attr))
             if lid is not None:
-                return lid
+                return (lid,)
         cands = index.attr_map.get(attr, ())
-        if len(cands) == 1:
-            return next(iter(cands))
-    return None
+        if 0 < len(cands) <= _MAY_ALIAS_CAP:
+            return tuple(sorted(cands))
+    return ()
 
 
 class _FnLockInfo:
@@ -210,15 +228,20 @@ def _scan_function(fn: ast.AST, src: Source, cls_name: Optional[str],
         if isinstance(node, (ast.With, ast.AsyncWith)):
             acquired: List[str] = []
             for item in node.items:
-                lid = _resolve_lock(item.context_expr, src, cls_name, index)
-                if lid is None:
-                    continue
-                for h in dict.fromkeys(held):
-                    if h != lid:
-                        edges.setdefault((h, lid), (src.rel, node.lineno))
-                info.acquisitions.append((lid, node.lineno))
-                held.append(lid)
-                acquired.append(lid)
+                lids = _resolve_locks(item.context_expr, src,
+                                      cls_name, index)
+                # edges only from locks held BEFORE this item: the lids
+                # of one item are may-aliases of ONE runtime lock, and
+                # an edge between aliases would be a fabricated order
+                prior = list(dict.fromkeys(held))
+                for lid in lids:
+                    for h in prior:
+                        if h != lid:
+                            edges.setdefault((h, lid),
+                                             (src.rel, node.lineno))
+                    info.acquisitions.append((lid, node.lineno))
+                    held.append(lid)
+                    acquired.append(lid)
             for stmt in node.body:
                 visit(stmt)
             for _ in acquired:

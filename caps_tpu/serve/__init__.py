@@ -21,6 +21,12 @@ The layer between many client threads and one engine session
                         sessions + replicated graphs, the health ladder
                         (healthy -> quarantined -> probing), background
                         canary probes, graph replication
+    serve/shards.py     shard groups: one hash-partitioned graph behind
+                        N member devices (capacity members mixed into
+                        the ReplicaSet) — single-shard routing,
+                        mesh-sharded cross-shard execution, group-level
+                        health ladder with background member rebuild,
+                        host-memory partition paging
     serve/server.py     QueryServer: worker pool (one worker per device
                         replica, or one serialized stream), serve.*
                         metrics, containment ladder, device failover,
@@ -74,6 +80,15 @@ _LAZY = {
     "DeviceReplica": "caps_tpu.serve.devices",
     "replicate_graph": "caps_tpu.serve.devices",
     "executing_device_index": "caps_tpu.serve.devices",
+    # sharded serving (serve/shards.py): partitioned graphs behind the
+    # same QueryServer — shard-group capacity members next to replicas
+    "ShardGroup": "caps_tpu.serve.shards",
+    "ShardGroupConfig": "caps_tpu.serve.shards",
+    "GraphPartition": "caps_tpu.serve.shards",
+    "partition_graph": "caps_tpu.serve.shards",
+    "executing_shard": "caps_tpu.serve.shards",
+    "ShardingUnsupported": "caps_tpu.serve.errors",
+    "ShardMemberDown": "caps_tpu.serve.errors",
 }
 
 __all__ = [

@@ -91,6 +91,28 @@ class ReplicationUnsupported(ServeError):
     requests against such graphs are pinned to device 0."""
 
 
+class ShardingUnsupported(ServeError):
+    """A graph or query that cannot be served by a shard group
+    (serve/shards.py): non-scan graphs cannot partition, and writes
+    against a partitioned graph are rejected — the commit lock does
+    not shard.  Classified FATAL: retrying cannot change it."""
+
+
+class ShardMemberDown(ServeError):
+    """A single-shard-routed query's owning member is quarantined and
+    its background rebuild has not finished.  Marked ``caps_transient``
+    at construction: the serving tier's retry ladder backs off and
+    re-executes — by then the rebuild may have reinstated the member —
+    instead of walking the poisoned-plan ladder."""
+
+    def __init__(self, message: str, member: Optional[int] = None):
+        super().__init__(message)
+        self.caps_transient = True
+        if member is not None:
+            #: member attribution for the group ladder (serve/shards.py)
+            self.caps_shard_member = member
+
+
 class CancellationError(ServeError):
     """Base of the two cooperative-cancel outcomes (deadline, explicit).
 

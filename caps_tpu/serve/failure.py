@@ -105,6 +105,35 @@ def device_of(exc: BaseException):
     return getattr(exc, "caps_device_index", None)
 
 
+def quarantine_plan_state(session, graph, query, params,
+                          exec_lock=None) -> None:
+    """Evict one family's shared cached state on ``session``: the
+    plan-cache entry anchored by (graph, query, params) and, on
+    backends with a fused executor, its size memos.  The ONE
+    poisoned-plan eviction sequence — the server's device path and the
+    shard-group path both call here, so containment semantics cannot
+    drift apart.  ``exec_lock`` (the owning execution stream's lock) is
+    held around the fused eviction: memo maps must not shrink under an
+    in-flight fused run.  Never raises — containment must not fail."""
+    import contextlib
+    try:
+        key_fn = getattr(session, "_plan_cache_key", None)
+        if key_fn is not None:
+            key = key_fn(graph, query, params)
+            if key is not None:
+                session.plan_cache.quarantine(key)
+    except Exception:  # pragma: no cover — containment must not fail
+        pass
+    fused = getattr(session, "fused", None)
+    if fused is not None:
+        try:
+            with (exec_lock if exec_lock is not None
+                  else contextlib.nullcontext()):
+                fused.forget(graph, query)
+        except Exception:  # pragma: no cover — containment must not fail
+            pass
+
+
 def classify(exc: BaseException) -> str:
     """Map one raised exception to its containment treatment."""
     # explicit marker wins: the fault harness and backend code stamp

@@ -1,0 +1,1266 @@
+"""Shard groups: partitioned graphs behind :class:`QueryServer`.
+
+ROADMAP item 2: PR 5 scaled *throughput* (N replicas, each holding the
+whole graph); capacity stayed capped at one device's HBM.  This module
+adds the capacity member type: a :class:`ShardGroup` is a set of member
+devices fronting ONE hash-partitioned graph, mixed into the same
+:class:`~caps_tpu.serve.devices.ReplicaSet` next to plain replicas.
+
+* **Partitioning** (:func:`partition_graph`): node rows hash by the
+  value of a designated partition property (nodes without it hash by
+  node id — a property-equality query can never match them, so they
+  never need routing); relationship rows follow their SOURCE node's
+  partition.  Partitions are kept as host-side column slices — the
+  "snapshot base" a member rebuild re-ingests from and the host arrays
+  cold partitions spill to.  Every partition keeps the SAME table
+  structure (mapping + column types) as the source graph, so every
+  member's schema is identical to the unsharded graph's.
+
+* **Routing** (:meth:`ShardGroup._route`): a query provably resident on
+  one shard — a single node pattern, no relationships, with an equality
+  on the partition property, and nothing in WHERE/RETURN that escapes
+  the matched rows (no EXISTS sub-queries, no other variables) —
+  executes on the OWNING member's partition session alone.  Everything
+  else is a cross-shard pattern and executes on the group's sharded
+  session: one engine session over a ``parallel/mesh.py`` mesh of the
+  group's devices, whose tables row-shard over the ``shard`` axis and
+  whose joins ride the existing okapi distributed-join machinery
+  (radix / salted / broadcast — MULTICHIP_r05).  Either way results are
+  exactly the unsharded session's (the digest-parity tests).
+
+* **Group health ladder** (the robustness core): member states ride the
+  same three-state breaker machine the device ladder uses, under a
+  ``serve.shard_breaker`` metric prefix.  ``member_failure_threshold``
+  consecutive member-attributed device faults quarantine the member and
+  DEGRADE the group — healthy members keep serving their shards, the
+  server's retry ladder covers the rest.  A background maintenance pass
+  (per-member canary probes on the breaker's cooldown cadence) rebuilds
+  the lost member onto a spare session — a fresh clone re-ingested from
+  the host partition slices — and reinstates it after its canary
+  passes.  ``group_failure_threshold`` failed rebuild cycles (or every
+  member down at once) QUARANTINE the group: the server sheds
+  group-routed traffic at admission with an honest ``retry_after_s``
+  while replica members keep serving, and claimed group batches requeue.
+  A dead shard device can never take the server down.
+
+* **Host-memory partition paging** (:class:`ShardGroup` pager): with a
+  ``page_budget_bytes`` per member, cold partitions spill to their host
+  slices (device buffers dropped, member plan-cache entries for the
+  spilled graph evicted) and fault back in on access — LRU per member,
+  placement decided from the member's resident-byte ledger plus
+  ``obs.ledger.device_bytes_in_use`` where the platform reports it.  A
+  graph larger than one device's budget serves correctly: cold
+  partitions are slower (re-ingest + re-plan), never wrong.
+  ``paging.faults`` / ``paging.spills`` counters and
+  ``paging.resident_bytes`` / ``paging.host_bytes`` gauges account it.
+
+Locking: the group serves ONE dispatch stream (``self.lock``, held by
+the server exactly like a replica's execution lock); every residency
+mutation (fault-in, spill, rebuild) happens under it, so the pager
+needs no lock of its own.  Group state transitions sit behind the
+separate ``_state_lock``, which is never held across an engine call.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_lock
+from caps_tpu.serve.breaker import (CLOSED, HALF_OPEN, OPEN, REJECT, TRIAL,
+                                    CircuitBreaker)
+from caps_tpu.serve.deadline import cancel_scope
+from caps_tpu.serve.errors import ShardMemberDown, ShardingUnsupported
+from caps_tpu.serve.failure import device_fault
+
+#: group health ladder states (``stats()["shards"]``)
+GROUP_HEALTHY = "healthy"
+GROUP_DEGRADED = "degraded"
+GROUP_QUARANTINED = "quarantined"
+
+#: member states mirror the device ladder's
+MEMBER_HEALTHY = "healthy"
+MEMBER_QUARANTINED = "quarantined"
+MEMBER_PROBING = "probing"
+
+_BREAKER_TO_MEMBER = {CLOSED: MEMBER_HEALTHY, OPEN: MEMBER_QUARANTINED,
+                      HALF_OPEN: MEMBER_PROBING}
+
+#: the group-level breaker key (member keys are ``("member", index)``)
+_GROUP_KEY = ("group",)
+
+#: per-member canary: a plain scan over a resident partition, so a
+#: fault scoped to this member's operator stream fails the probe too
+_CANARY_QUERY = "MATCH (n) RETURN n LIMIT 1"
+
+#: bounded ring of group state transitions (bench reporting)
+_MAX_TRANSITIONS = 64
+
+#: routing decisions cached per query text (parse once per text)
+_ROUTE_CACHE_CAP = 128
+
+_shard_tls = threading.local()
+
+_gauge_guard = make_lock("shards._gauge_guard")
+
+
+def executing_shard() -> Optional[Tuple[str, Optional[int]]]:
+    """``(group_name, member_index)`` for the calling thread's current
+    shard-group execution bracket — ``member_index`` is None for a
+    group-wide (cross-shard) execution, which runs on EVERY member's
+    device at once.  The shard-scoped fault injectors
+    (``testing/faults.py`` ``shard_loss`` / ``sick_shard``) key off
+    this; None outside any group bracket."""
+    return getattr(_shard_tls, "shard", None)
+
+
+def _attribute_member(exc: BaseException, member_index: int) -> None:
+    """Stamp the member index a group execution failure was observed on
+    (first-writer-wins, like ``attribute_device``)."""
+    try:
+        if getattr(exc, "caps_shard_member", None) is None:
+            exc.caps_shard_member = member_index
+    except Exception:  # pragma: no cover — immutable exception types
+        pass
+
+
+def member_of(exc: BaseException) -> Optional[int]:
+    """The member index stamped on a group execution failure (None for
+    group-wide / unattributed failures)."""
+    return getattr(exc, "caps_shard_member", None)
+
+
+# -- partitioning ------------------------------------------------------------
+
+def hash_value(value: Any) -> int:
+    """Stable, process-independent hash of a partition-property value
+    (``hash()`` is salted per process and would re-partition every
+    restart).  Numerically-equal ints and floats hash IDENTICALLY —
+    Cypher's ``5 = 5.0`` is true, so a float-typed parameter against an
+    int-stored property must route to the shard that stored it (a
+    type-sensitive hash would silently return empty results).  Booleans
+    are not Cypher numbers and hash apart from 0/1."""
+    if isinstance(value, bool):
+        token = f"b:{value}"
+    elif isinstance(value, float) and value.is_integer():
+        token = f"i:{int(value)}"
+    elif isinstance(value, int):
+        token = f"i:{value}"
+    elif isinstance(value, float):
+        token = f"f:{value!r}"
+    elif isinstance(value, str):
+        token = f"s:{value}"
+    elif value is None:
+        token = "n:"
+    else:
+        token = f"o:{value!r}"
+    return zlib.crc32(token.encode("utf-8"))
+
+
+@dataclasses.dataclass
+class _HostSlice:
+    """One entity table's rows for one partition, held as host columns —
+    the rebuild source and the paging spill target.  ``mapping`` is the
+    SOURCE table's mapping, so the rebuilt table's schema is identical
+    by construction."""
+
+    kind: str                     # "node" | "rel"
+    mapping: Any                  # NodeMapping | RelationshipMapping
+    data: Dict[str, List[Any]]
+    types: Dict[str, Any]
+    rows: int
+
+    def host_nbytes(self) -> int:
+        """Rough host footprint (the ``paging.host_bytes`` gauge): 8
+        bytes per scalar cell plus string payloads — an estimate, not
+        an allocator read (host lists have no exact nbytes)."""
+        total = 0
+        for vals in self.data.values():
+            total += 8 * len(vals)
+            for v in vals:
+                if isinstance(v, str):
+                    total += len(v)
+        return total
+
+
+@dataclasses.dataclass
+class GraphPartition:
+    """One hash partition of the served graph: host-side slices of every
+    entity table (same table structure as the source, rows filtered to
+    this partition)."""
+
+    index: int
+    node_slices: List[_HostSlice]
+    rel_slices: List[_HostSlice]
+
+    @property
+    def rows(self) -> int:
+        return sum(s.rows for s in self.node_slices) + \
+            sum(s.rows for s in self.rel_slices)
+
+    def host_nbytes(self) -> int:
+        return sum(s.host_nbytes() for s in self.node_slices) + \
+            sum(s.host_nbytes() for s in self.rel_slices)
+
+    def build(self, session):
+        """Ingest this partition through ``session``'s table factory —
+        per-shard CSR ingest: the member ends up with its own
+        device-resident buffers for exactly its rows."""
+        from caps_tpu.relational.entity_tables import (NodeTable,
+                                                       RelationshipTable)
+        factory = session.table_factory
+        nts = [NodeTable(s.mapping,
+                         factory.from_columns(s.data, s.types))
+               for s in self.node_slices]
+        rts = [RelationshipTable(s.mapping,
+                                 factory.from_columns(s.data, s.types))
+               for s in self.rel_slices]
+        return session.create_graph(nts, rts)
+
+
+def _table_host_columns(table) -> Dict[str, List[Any]]:
+    return {c: list(table.column_values(c)) for c in table.columns}
+
+
+def partition_graph(graph, n_partitions: int,
+                    partition_property: str = "id"
+                    ) -> List[GraphPartition]:
+    """Hash-partition a scan graph's rows into ``n_partitions`` host
+    slices.  Node rows hash by ``partition_property``'s value when the
+    table maps that property (else by node id); relationship rows
+    follow their source node's partition, so each partition's CSR holds
+    the edges fanning out of its own nodes."""
+    from caps_tpu.relational.graphs import ScanGraph
+    if not isinstance(graph, ScanGraph):
+        raise ShardingUnsupported(
+            f"only scan graphs partition (got {type(graph).__name__}); "
+            f"versioned/union/catalog graphs stay on replica members")
+    n = max(1, int(n_partitions))
+    node_home: Dict[int, int] = {}
+    node_parts: List[List[Tuple[Any, Dict[str, List[Any]], Dict, int]]] = \
+        [[] for _ in range(n)]
+    for nt in graph.node_tables:
+        table = nt.table
+        cols = _table_host_columns(table)
+        types = {c: table.column_type(c) for c in table.columns}
+        ids = cols[nt.mapping.id_col]
+        pcol = nt.mapping.property_cols.get(partition_property)
+        pvals = cols.get(pcol) if pcol is not None else None
+        rows_by_part: List[List[int]] = [[] for _ in range(n)]
+        for i, nid in enumerate(ids):
+            v = pvals[i] if pvals is not None else None
+            p = (hash_value(v) if v is not None
+                 else hash_value(f"#id:{int(nid)}")) % n
+            node_home[int(nid)] = p
+            rows_by_part[p].append(i)
+        for p in range(n):
+            rows = rows_by_part[p]
+            node_parts[p].append((
+                nt.mapping,
+                {c: [vals[i] for i in rows] for c, vals in cols.items()},
+                types, len(rows)))
+    rel_parts: List[List[Tuple[Any, Dict[str, List[Any]], Dict, int]]] = \
+        [[] for _ in range(n)]
+    for rt in graph.rel_tables:
+        table = rt.table
+        cols = _table_host_columns(table)
+        types = {c: table.column_type(c) for c in table.columns}
+        srcs = cols[rt.mapping.source_col]
+        rows_by_part = [[] for _ in range(n)]
+        for i, src in enumerate(srcs):
+            p = node_home.get(int(src))
+            if p is None:  # dangling edge: hash the source id itself
+                p = hash_value(f"#id:{int(src)}") % n
+            rows_by_part[p].append(i)
+        for p in range(n):
+            rows = rows_by_part[p]
+            rel_parts[p].append((
+                rt.mapping,
+                {c: [vals[i] for i in rows] for c, vals in cols.items()},
+                types, len(rows)))
+    out = []
+    for p in range(n):
+        out.append(GraphPartition(
+            p,
+            [_HostSlice("node", m, d, t, r)
+             for m, d, t, r in node_parts[p]],
+            [_HostSlice("rel", m, d, t, r)
+             for m, d, t, r in rel_parts[p]]))
+    return out
+
+
+# -- configuration -----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ShardGroupConfig:
+    #: group name — the fault injectors and stats key by it
+    name: str = "shard0"
+    #: member devices fronting the partitioned graph
+    members: int = 2
+    #: the node property whose value equality routes a query to one
+    #: shard; nodes without it hash by id (and are never routed to)
+    partition_property: str = "id"
+    #: partitions per member (> 1 gives the pager units to spill)
+    partitions_per_member: int = 1
+    #: per-member device budget for resident partitions; None = no
+    #: paging pressure (everything stays resident)
+    page_budget_bytes: Optional[int] = None
+    #: consecutive member-attributed device faults before a member
+    #: quarantines (degrading the group)
+    member_failure_threshold: int = 2
+    #: cooldown before each background probe/rebuild attempt
+    member_cooldown_s: float = 1.0
+    #: failed rebuild cycles (or unattributed group-wide device faults)
+    #: before the whole GROUP quarantines and its traffic sheds
+    group_failure_threshold: int = 3
+    #: build the cross-shard session over a ``parallel/mesh.py`` mesh of
+    #: ``members`` devices (row-sharded tables + okapi dist joins); off
+    #: (or on backends without a mesh) the cross session is a plain
+    #: full-graph clone — same results, no capacity win for that path
+    cross_shard_mesh: bool = True
+
+
+# -- members -----------------------------------------------------------------
+
+class ShardMember:
+    """One member device's serving state: its own session (per-member
+    plan cache / string pool — compiled state never crosses members,
+    docs/tpu.md), the partitions it owns, and which of them are
+    device-resident right now (insertion order = LRU)."""
+
+    def __init__(self, index: int, session, partitions: List[int]):
+        self.index = index
+        self.session = session
+        #: partition indices this member owns
+        self.partitions = list(partitions)
+        #: pidx -> (graph, page_cost_bytes); insertion-ordered LRU.
+        #: The cost is the partition's HOST-slice estimate — one stable
+        #: currency for every budget decision, known before first build.
+        self.resident: "OrderedDict[int, Tuple[Any, int]]" = OrderedDict()
+        #: pidx -> measured device-table bytes (reporting; populated at
+        #: each build)
+        self.measured_nbytes: Dict[int, int] = {}
+        #: bumped on every rebuild: the "spare/recovered device"
+        self.incarnation = 0
+        self.requests = 0
+        self.failed = 0
+        self.rebuilds = 0
+        self.probes = 0
+        self.quarantines = 0
+        self.reinstates = 0
+        self.page_faults = 0
+        self.page_spills = 0
+
+    def resident_bytes(self) -> int:
+        """Resident page cost (host-estimate currency — what the budget
+        is checked against)."""
+        return sum(nb for _g, nb in self.resident.values())
+
+    def resident_device_bytes(self) -> int:
+        """Measured device-table bytes of the resident partitions."""
+        return sum(self.measured_nbytes.get(p, 0) for p in self.resident)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"member": self.index,
+                "partitions": list(self.partitions),
+                "resident": list(self.resident.keys()),
+                "resident_bytes": self.resident_bytes(),
+                "resident_device_bytes": self.resident_device_bytes(),
+                "incarnation": self.incarnation,
+                "requests": self.requests, "failed": self.failed,
+                "rebuilds": self.rebuilds, "probes": self.probes,
+                "quarantines": self.quarantines,
+                "reinstates": self.reinstates,
+                "page_faults": self.page_faults,
+                "page_spills": self.page_spills}
+
+
+def _register_group_gauges(registry) -> None:
+    """Registry-level ``shard.*`` / ``paging.*`` gauges over the LIVE
+    groups on this registry (several servers can share one session —
+    the admission depth gauge's live-set pattern): groups join the set
+    at construction and leave it in :meth:`ShardGroup.close`, so a dead
+    server's groups neither report stale bytes nor stay pinned."""
+    with _gauge_guard:
+        live = getattr(registry, "_shard_live_groups", None)
+        if live is None:
+            live = registry._shard_live_groups = []
+            registry.gauge("shard.groups", fn=lambda: len(live))
+            registry.gauge(
+                "shard.degraded",
+                fn=lambda: sum(1 for g in live
+                               if g.health() != GROUP_HEALTHY))
+            registry.gauge(
+                "paging.resident_bytes",
+                fn=lambda: sum(m.resident_bytes()
+                               for g in live for m in g.members))
+            registry.gauge(
+                "paging.host_bytes",
+                fn=lambda: sum(g.cold_host_bytes() for g in live))
+
+
+class _GroupSessionFacade:
+    """The session-shaped surface the server executes a group through:
+    ``cypher_on_graph`` / ``cypher_batch`` / ``cypher_degraded`` route
+    each query to the owning member's partition session or the group's
+    sharded cross-shard session.  The server's whole containment
+    machinery (micro-batching, retry ladder, breakers, telemetry) works
+    on a group exactly as on a replica because of this seam."""
+
+    def __init__(self, group: "ShardGroup"):
+        self._group = group
+
+    @property
+    def tracer(self):
+        return self._group.template_session.tracer
+
+    def cypher_on_graph(self, graph, query, parameters=None):
+        return self._group.execute(query, parameters)
+
+    def cypher_batch(self, graph, items, scopes=None):
+        out: List[Any] = []
+        for i, (query, params) in enumerate(items):
+            scope = scopes[i] if scopes is not None else None
+            try:
+                with cancel_scope(scope):
+                    out.append(self._group.execute(query, params))
+            except Exception as ex:
+                out.append(ex)
+        return out
+
+    def cypher_degraded(self, graph, query, parameters=None, *,
+                        no_plan_cache: bool = True,
+                        no_fused: bool = False):
+        return self._group.execute(query, parameters,
+                                   degraded=(no_plan_cache, no_fused))
+
+
+class ShardGroup:
+    """N member devices fronting one hash-partitioned graph — a
+    capacity member of the :class:`~caps_tpu.serve.devices.ReplicaSet`,
+    duck-typed as a replica (``index`` / ``lock`` / ``session`` /
+    ``activate`` / ``graph_for`` / ``note``) so the server's dispatch,
+    retry, and telemetry paths treat it like any other execution
+    stream."""
+
+    def __init__(self, session, graph, config: ShardGroupConfig,
+                 registry, event_log=None, index: int = 0,
+                 on_change=None):
+        if config.members < 1:
+            raise ShardingUnsupported("a shard group needs >= 1 member")
+        if getattr(graph, "graph_is_versioned", False):
+            raise ShardingUnsupported(
+                "shard groups serve static scan graphs; versioned "
+                "graphs stay on replica members (writes need the "
+                "commit lock, which does not shard)")
+        self.config = config
+        self.name = config.name
+        self.graph = graph
+        self.index = index
+        self.template_session = session
+        self._registry = registry
+        self._event_log = event_log
+        self._on_change = on_change
+        #: ONE dispatch stream per group (the server holds it around
+        #: every execution, probes and rebuilds take it too) — all
+        #: residency mutations happen under it
+        self.lock = make_lock("shards.ShardGroup.lock")
+        self._state_lock = make_lock("shards.ShardGroup._state_lock")
+        n = config.members
+        n_parts = n * max(1, config.partitions_per_member)
+        self.partitions = partition_graph(graph, n_parts,
+                                          config.partition_property)
+        self.members: List[ShardMember] = [
+            ShardMember(i, self._member_session(),
+                        [p for p in range(n_parts) if p % n == i])
+            for i in range(n)]
+        #: cross-shard path: one session over a mesh of the group's
+        #: devices (tables row-shard over the mesh axis, joins ride the
+        #: okapi dist-join machinery); falls back to a plain full-graph
+        #: clone when the backend has no mesh or devices are short
+        self.cross_session, self.cross_meshed = self._cross_shard_session()
+        from caps_tpu.serve.devices import replicate_graph
+        with self._bracket(None):
+            self.cross_graph = replicate_graph(graph, self.cross_session)
+        self._facade = _GroupSessionFacade(self)
+        #: member + group ladder: the same three-state breaker machine
+        #: as the device ladder, group-scoped metric prefix
+        self._breaker = CircuitBreaker(
+            registry, failure_threshold=config.member_failure_threshold,
+            cooldown_s=config.member_cooldown_s,
+            metric_prefix="serve.shard_breaker")
+        #: group-level consecutive failures (rebuild cycles that failed,
+        #: unattributed group-wide device faults) — NOT the member count
+        self._group_failures = 0
+        self._group_open_t: Optional[float] = None
+        self._requests_single = registry.counter("shard.requests.single")
+        self._requests_cross = registry.counter("shard.requests.cross")
+        self._member_quarantined_c = registry.counter(
+            "shard.member.quarantined")
+        self._member_reinstated_c = registry.counter(
+            "shard.member.reinstated")
+        self._rebuilds_c = registry.counter("shard.rebuilds")
+        self._rebuild_failures_c = registry.counter(
+            "shard.rebuild_failures")
+        self._probes_c = registry.counter("shard.probes")
+        self._group_quarantined_c = registry.counter(
+            "shard.group_quarantined")
+        self._shed_c = registry.counter("shard.shed")
+        self._faults_c = registry.counter("paging.faults")
+        self._spills_c = registry.counter("paging.spills")
+        self._route_cache: "OrderedDict[str, Optional[Tuple]]" = \
+            OrderedDict()
+        self._transitions: List[Dict[str, Any]] = [
+            {"t": clock.now(), "state": GROUP_HEALTHY}]
+        self._state = GROUP_HEALTHY
+        self._next_tick_t = 0.0
+        self._maint_stop = threading.Event()
+        self._maint_thread: Optional[threading.Thread] = None
+        self._closed = False
+        # replica-compatible counters (server _note_device_outcomes)
+        self._stats_lock = make_lock("shards.ShardGroup._stats_lock")
+        self.requests = 0
+        self.completed = 0
+        self.failed = 0
+        #: eager ingest up to the page budget: serving pays no surprise
+        #: re-ingest for the hot set, cold partitions stay on the host
+        with self.lock:
+            for m in self.members:
+                for pidx in m.partitions:
+                    if not self._fits(m, self.partitions[pidx]):
+                        break
+                    self._fault_in(m, pidx, count_fault=False)
+        _register_group_gauges(registry)
+        registry._shard_live_groups.append(self)
+
+    # -- replica duck type ---------------------------------------------
+
+    @property
+    def session(self):
+        return self._facade
+
+    @property
+    def device(self):  # placement string for summaries
+        return f"shard-group:{self.name}"
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Group-wide execution bracket (cross-shard dispatch runs on
+        every member's device at once): stamps ``executing_shard()``
+        with ``(name, None)``.  Member-scoped brackets nest inside."""
+        with self._bracket(None):
+            yield
+
+    @contextlib.contextmanager
+    def _bracket(self, member_index: Optional[int]):
+        prev = getattr(_shard_tls, "shard", None)
+        _shard_tls.shard = (self.name, member_index)
+        try:
+            yield
+        finally:
+            _shard_tls.shard = prev
+
+    def graph_for(self, graph):
+        """Identity: routing happens inside the facade, per query."""
+        return graph
+
+    def serves(self, graph) -> bool:
+        return graph is self.graph
+
+    def note(self, *, requests: int = 0, completed: int = 0,
+             failed: int = 0) -> None:
+        with self._stats_lock:
+            self.requests += requests
+            self.completed += completed
+            self.failed += failed
+
+    # -- construction helpers ------------------------------------------
+
+    def _member_session(self):
+        """A fresh mesh-free clone for one member: the member's
+        partition is a single-device graph whatever the template's own
+        mesh config is."""
+        cfg = getattr(self.template_session, "config", None)
+        if cfg is not None and getattr(cfg, "mesh_shape", ()):
+            return type(self.template_session)(
+                config=dataclasses.replace(cfg, mesh_shape=()))
+        return self.template_session.clone()
+
+    def _cross_shard_session(self):
+        """The cross-shard session: a clone over ``mesh_shape =
+        (members,)`` when the backend supports meshes and the platform
+        has the devices; else a plain clone (correct, unsharded)."""
+        cfg = getattr(self.template_session, "config", None)
+        if self.config.cross_shard_mesh and cfg is not None \
+                and hasattr(cfg, "mesh_shape") \
+                and hasattr(self.template_session, "backend"):
+            try:
+                s = type(self.template_session)(
+                    config=dataclasses.replace(
+                        cfg, mesh_shape=(self.config.members,)))
+                if getattr(s.backend, "mesh", None) is not None \
+                        or self.config.members == 1:
+                    return s, getattr(s.backend, "mesh", None) is not None
+            except Exception:  # pragma: no cover — meshless platform
+                pass           # fall through to the unmeshed clone
+        return self.template_session.clone(), False
+
+    # -- paging ---------------------------------------------------------
+
+    def _partition_cost(self, pidx: int) -> int:
+        """The pager's ONE byte currency: the partition's host-slice
+        estimate — stable, known before the first build, identical on
+        both sides of every budget comparison (a never-built partition
+        has no measured device size yet; mixing currencies would make
+        admission decisions erratic)."""
+        return self.partitions[pidx].host_nbytes()
+
+    def _device_pressure(self, member: ShardMember) -> int:
+        """The pager's placement input: this member's tracked resident
+        bytes, raised to the platform's reported per-device allocator
+        bytes when the device can report them (obs/ledger.py — honest
+        zero on platforms that cannot)."""
+        tracked = member.resident_bytes()
+        from caps_tpu.obs.ledger import device_bytes_in_use
+        n = max(1, len(self.members))
+        return max(tracked, device_bytes_in_use() // n)
+
+    def _fits(self, member: ShardMember, partition: GraphPartition
+              ) -> bool:
+        budget = self.config.page_budget_bytes
+        if budget is None:
+            return True
+        return self._device_pressure(member) \
+            + partition.host_nbytes() <= budget
+
+    def _fault_in(self, member: ShardMember, pidx: int,
+                  count_fault: bool = True):
+        """Make a partition device-resident (caller holds the group
+        lock): spill LRU siblings while over budget, then ingest from
+        the host slice.  The incoming partition is always admitted —
+        serving a query beats honoring the budget to the byte."""
+        got = member.resident.get(pidx)
+        if got is not None:
+            member.resident.move_to_end(pidx)
+            return got[0]
+        budget = self.config.page_budget_bytes
+        incoming = self._partition_cost(pidx)
+        if budget is not None:
+            # same pressure reading as the eager-ingest _fits check —
+            # ONE currency on both sides of every budget decision
+            while member.resident and \
+                    self._device_pressure(member) + incoming > budget:
+                self._spill(member, next(iter(member.resident)))
+        with self._bracket(member.index):
+            graph = self.partitions[pidx].build(member.session)
+        from caps_tpu.obs.ledger import tables_nbytes
+        member.measured_nbytes[pidx] = tables_nbytes(
+            tuple(graph.node_tables) + tuple(graph.rel_tables))
+        member.resident[pidx] = (graph, incoming)
+        if count_fault:
+            member.page_faults += 1
+            self._faults_c.inc()
+        return graph
+
+    def _spill(self, member: ShardMember, pidx: int) -> None:
+        """Drop a partition's device residency: the graph (and its
+        device buffers) go, the member session's plan-cache entries
+        anchored on it are evicted (a later fault-in is a NEW graph
+        object — stale entries would only pin memory), and the host
+        slice remains the truth."""
+        graph, _nb = member.resident.pop(pidx)
+        token = getattr(graph, "_plan_token", None)
+        if token is not None:
+            try:
+                member.session.plan_cache.evict_graph(token)
+            except Exception:  # pragma: no cover — accounting only
+                pass
+        member.page_spills += 1
+        self._spills_c.inc()
+
+    def cold_host_bytes(self) -> int:
+        """Host bytes of partitions currently NOT device-resident."""
+        total = 0
+        for m in self.members:
+            for pidx in m.partitions:
+                if pidx not in m.resident:
+                    total += self.partitions[pidx].host_nbytes()
+        return total
+
+    # -- routing --------------------------------------------------------
+
+    def _route(self, query: str) -> Optional[Tuple[str, Any]]:
+        """``("param", name)`` / ``("lit", value)`` when the query is
+        provably resident on the shard owning that partition-property
+        value; None = cross-shard.  Cached per query text."""
+        with self._state_lock:
+            if query in self._route_cache:
+                self._route_cache.move_to_end(query)
+                return self._route_cache[query]
+        route = self._compute_route(query)
+        with self._state_lock:
+            self._route_cache[query] = route
+            while len(self._route_cache) > _ROUTE_CACHE_CAP:
+                self._route_cache.popitem(last=False)
+        return route
+
+    def _compute_route(self, query: str) -> Optional[Tuple[str, Any]]:
+        from caps_tpu.frontend import ast
+        from caps_tpu.frontend.parser import parse_query, query_mode
+        from caps_tpu.ir import exprs as E
+        mode, body = query_mode(query)
+        if mode is not None:
+            return None  # EXPLAIN/PROFILE: run on the cross session
+        try:
+            from caps_tpu.relational.updates import is_update_query
+            if is_update_query(body):
+                return None
+            stmt = parse_query(body)
+        except Exception:
+            return None  # let the normal path raise the real error
+        if not isinstance(stmt, ast.SingleQuery):
+            return None
+        matches = [c for c in stmt.clauses
+                   if isinstance(c, ast.MatchClause)]
+        if len(matches) != 1 or any(
+                not isinstance(c, (ast.MatchClause, ast.WithClause,
+                                   ast.ReturnClause))
+                for c in stmt.clauses):
+            return None
+        m = matches[0]
+        if m.optional or len(m.pattern.parts) != 1:
+            return None
+        part = m.pattern.parts[0]
+        if part.rels or len(part.nodes) != 1 or part.path_var:
+            return None
+        node = part.nodes[0]
+        cand = None
+        if isinstance(node.properties, E.MapLit):
+            for k, v in zip(node.properties.keys, node.properties.values):
+                if k == self.config.partition_property and \
+                        isinstance(v, (E.Param, E.Lit)):
+                    cand = v
+        if cand is None and m.where is not None and node.var is not None:
+            conjs = m.where.exprs if isinstance(m.where, E.Ands) \
+                else (m.where,)
+            for e in conjs:
+                if not isinstance(e, E.Equals):
+                    continue
+                for lhs, rhs in ((e.lhs, e.rhs), (e.rhs, e.lhs)):
+                    if isinstance(lhs, E.Property) \
+                            and lhs.entity == E.Var(node.var) \
+                            and lhs.key == self.config.partition_property \
+                            and isinstance(rhs, (E.Param, E.Lit)):
+                        cand = rhs
+                        break
+                if cand is not None:
+                    break
+        if cand is None:
+            return None
+        # nothing may escape the matched rows: a variable outside the
+        # running binding set (the node var, plus projection aliases
+        # WITH derives FROM it), or a sub-query/path construct anywhere
+        # in WHERE / WITH / RETURN, could read graph data living on
+        # OTHER shards
+        escape = (E.ExistsSubQuery, E.Exists, E.PathExpr, E.PathSeg,
+                  E.PathNode, E.PathNodes)
+
+        def clean(tree, allowed) -> bool:
+            for n_ in tree.walk():
+                if isinstance(n_, escape):
+                    return False
+                if isinstance(n_, E.Var) and n_.name not in allowed:
+                    return False
+            return True
+
+        allowed = {node.var} if node.var is not None else set()
+        for clause in stmt.clauses:
+            if isinstance(clause, ast.MatchClause):
+                if clause.where is not None and \
+                        not clean(clause.where, allowed):
+                    return None
+                continue
+            body = clause.body
+            introduced = set()
+            for item in body.items:
+                if not clean(item.expr, allowed):
+                    return None
+                if item.alias is not None:
+                    introduced.add(item.alias)
+                elif isinstance(item.expr, E.Var):
+                    introduced.add(item.expr.name)
+            visible = allowed | introduced
+            for o in body.order_by:
+                if not clean(o.expr, visible):
+                    return None
+            where = getattr(clause, "where", None)
+            if where is not None and not clean(where, visible):
+                return None
+            if isinstance(clause, ast.WithClause):
+                allowed = visible if body.star else introduced
+        if isinstance(cand, E.Param):
+            return ("param", cand.name)
+        return ("lit", cand.value)
+
+    def owning_member(self, value: Any) -> Tuple[int, ShardMember]:
+        pidx = hash_value(value) % len(self.partitions)
+        return pidx, self.members[pidx % len(self.members)]
+
+    # -- execution ------------------------------------------------------
+
+    def execute(self, query: str,
+                parameters: Optional[Mapping[str, Any]] = None,
+                degraded: Optional[Tuple[bool, bool]] = None):
+        """One query through the group (caller holds ``self.lock`` via
+        the server's dispatch): route to the owning member's partition
+        session or the cross-shard session; failures are attributed to
+        the executing member for the health ladder."""
+        params = dict(parameters or {})
+        from caps_tpu.relational.updates import is_update_query
+        from caps_tpu.frontend.parser import query_mode
+        mode, body = query_mode(query)
+        if is_update_query(body if mode is not None else query):
+            raise ShardingUnsupported(
+                f"writes are not served by shard group {self.name!r}: "
+                f"partitioned graphs are read-only (route writes to a "
+                f"replica-served versioned graph)")
+        route = self._route(query)
+        value: Any = None
+        routed = False
+        if route is not None:
+            kind, token = route
+            if kind == "lit":
+                value, routed = token, True
+            elif token in params:
+                value, routed = params[token], True
+        if routed:
+            pidx, member = self.owning_member(value)
+            return self._execute_member(member, pidx, query, params,
+                                        degraded)
+        return self._execute_cross(query, params, degraded)
+
+    def _execute_member(self, member: ShardMember, pidx: int, query,
+                        params, degraded):
+        state = self.member_state(member.index)
+        if state != MEMBER_HEALTHY:
+            # fast transient failure: the server's retry ladder backs
+            # off while the background rebuild brings the member back
+            raise ShardMemberDown(
+                f"shard member {member.index} of group {self.name!r} is "
+                f"{state}; rebuild in progress", member=member.index)
+        member.requests += 1
+        self._requests_single.inc()
+        try:
+            with self._bracket(member.index):
+                graph = self._fault_in(member, pidx)
+                out = self._run(member.session, graph, query, params,
+                                degraded)
+        except BaseException as ex:
+            member.failed += 1
+            _attribute_member(ex, member.index)
+            raise
+        # consecutive-failure semantics for the MEMBER ladder too: a
+        # served request ends the member's streak (the device ladder
+        # does the same per request).  Guarded on CLOSED so a trip that
+        # raced in from another request's bookkeeping is never undone
+        # by a success that started before it.
+        key = ("member", member.index)
+        if self._breaker.state(key) == CLOSED:
+            self._breaker.record_success(key)
+        return out
+
+    def _execute_cross(self, query, params, degraded):
+        self._requests_cross.inc()
+        with self._bracket(None):
+            return self._run(self.cross_session, self.cross_graph,
+                             query, params, degraded)
+
+    @staticmethod
+    def _run(session, graph, query, params, degraded):
+        if degraded is not None:
+            no_plan_cache, no_fused = degraded
+            return session.cypher_degraded(graph, query, params,
+                                           no_plan_cache=no_plan_cache,
+                                           no_fused=no_fused)
+        return session.cypher_on_graph(graph, query, params)
+
+    def quarantine_family(self, query: str,
+                          params: Mapping[str, Any]) -> None:
+        """Poisoned-plan quarantine, group-routed: evict the cached
+        plan entry on the session that actually served this family
+        (the owning member or the cross session)."""
+        from caps_tpu.serve.failure import quarantine_plan_state
+        route = self._route(query)
+        params = dict(params or {})
+        session, graph = self.cross_session, self.cross_graph
+        if route is not None:
+            kind, token = route
+            value = token if kind == "lit" else params.get(token)
+            if kind == "lit" or token in params:
+                pidx, member = self.owning_member(value)
+                got = member.resident.get(pidx)
+                if got is None:
+                    return  # nothing resident: nothing cached to poison
+                session, graph = member.session, got[0]
+        # the shared eviction sequence (serve/failure.py), under the
+        # group's one dispatch stream lock
+        quarantine_plan_state(session, graph, query, params,
+                              exec_lock=self.lock)
+
+    # -- ladder bookkeeping (the server's outcome feed) ----------------
+
+    def record_success(self) -> None:
+        self.note(completed=1)
+        # consecutive-failure semantics, like every other breaker in
+        # the tier: a served group request ends the group-level streak
+        # (an OPEN group never serves, so this can never mask a real
+        # quarantine — only prevent a slow trickle of transient
+        # cross-shard wobbles from ever summing to one)
+        with self._state_lock:
+            if self._group_open_t is None:
+                self._group_failures = 0
+
+    def record_failure(self, exc: BaseException) -> Optional[str]:
+        """Fold one group execution failure in.  Returns ``"member"`` /
+        ``"group"`` when THIS failure tripped that ladder level (the
+        server flight-dumps and events it), else None.  Only
+        device-attributed failures climb — a user's bad query never
+        degrades a group."""
+        self.note(failed=1)
+        if not device_fault(exc):
+            return None
+        member_idx = member_of(exc)
+        tripped: Optional[str] = None
+        if member_idx is not None and 0 <= member_idx < len(self.members):
+            if self._breaker.record_failure(("member", member_idx), exc):
+                self.members[member_idx].quarantines += 1
+                self._member_quarantined_c.inc()
+                tripped = "member"
+        else:
+            # group-wide (cross-shard) device fault with no member
+            # attribution: counts against the GROUP ladder directly
+            if self._note_group_failure(exc):
+                tripped = "group"
+        if self._all_members_down() and self._group_open_t is None:
+            with self._state_lock:
+                self._group_open_t = clock.now()
+            self._group_quarantined_c.inc()
+            tripped = "group"
+        self._recompute_state()
+        return tripped
+
+    def _note_group_failure(self, exc: Optional[BaseException]) -> bool:
+        with self._state_lock:
+            self._group_failures += 1
+            if self._group_failures >= \
+                    self.config.group_failure_threshold \
+                    and self._group_open_t is None:
+                self._group_open_t = clock.now()
+                quarantined = True
+            else:
+                quarantined = False
+        if quarantined:
+            self._group_quarantined_c.inc()
+        return quarantined
+
+    def _note_group_success(self) -> None:
+        with self._state_lock:
+            self._group_failures = 0
+            self._group_open_t = None
+
+    def _all_members_down(self) -> bool:
+        return all(self.member_state(m.index) != MEMBER_HEALTHY
+                   for m in self.members)
+
+    def member_state(self, index: int) -> str:
+        return _BREAKER_TO_MEMBER[self._breaker.state(("member", index))]
+
+    def member_health(self) -> Dict[int, str]:
+        return {m.index: self.member_state(m.index) for m in self.members}
+
+    def health(self) -> str:
+        """``healthy`` (every member serving) / ``degraded`` (>= 1
+        member down or probing — the rest keep serving their shards) /
+        ``quarantined`` (group-level trip or every member down: the
+        server sheds group traffic with an honest retry hint)."""
+        if self._group_open_t is not None or self._all_members_down():
+            return GROUP_QUARANTINED
+        if any(self.member_state(m.index) != MEMBER_HEALTHY
+               for m in self.members):
+            return GROUP_DEGRADED
+        return GROUP_HEALTHY
+
+    def shed_retry_after(self) -> Optional[float]:
+        """Non-None when group-routed traffic should shed at admission:
+        the remaining member cooldown — the earliest time the
+        background rebuild could have changed anything."""
+        if self.health() != GROUP_QUARANTINED:
+            return None
+        self._shed_c.inc()
+        with self._state_lock:
+            opened = self._group_open_t
+        if opened is None:
+            return self.config.member_cooldown_s
+        remaining = self.config.member_cooldown_s - (clock.now() - opened)
+        return max(0.001, remaining)
+
+    def _recompute_state(self) -> None:
+        state = self.health()
+        changed = False
+        with self._state_lock:
+            if state != self._state:
+                self._state = state
+                self._transitions.append({"t": clock.now(),
+                                          "state": state})
+                del self._transitions[:-_MAX_TRANSITIONS]
+                changed = True
+        if changed:
+            tracer = self.template_session.tracer
+            if tracer.enabled:
+                tracer.event("shard.group_state", group=self.name,
+                             state=state)
+            if self._event_log is not None:
+                self._event_log.emit(
+                    "shard.group_state", request_id=None, family=None,
+                    group=self.name, state=state)
+            if self._on_change is not None:
+                try:
+                    self._on_change()
+                except Exception:  # pragma: no cover — bookkeeping only
+                    pass
+
+    # -- background probe / rebuild ------------------------------------
+
+    def probe_gate(self) -> Tuple[str, float]:
+        """Rate limit for the maintenance driver (the server's
+        quarantined-worker idle loop calls through here): ``(TRIAL, 0)``
+        at most once per nap interval — :meth:`maintenance_tick` itself
+        respects each member's breaker cooldown."""
+        nap = min(self.config.member_cooldown_s, 0.05)
+        now = clock.now()
+        with self._state_lock:
+            if now < self._next_tick_t:
+                return REJECT, self._next_tick_t - now
+            self._next_tick_t = now + nap
+        return TRIAL, 0.0
+
+    def maintenance_tick(self) -> bool:
+        """One background maintenance pass: for every quarantined member
+        whose cooldown elapsed, rebuild it onto a spare session from the
+        host partition slices (the snapshot base) and canary-probe it.
+        Success reinstates the member (and feeds the group ladder a
+        success); failure buys another cooldown and counts toward group
+        quarantine.  Returns True when any member was reinstated."""
+        reinstated = False
+        for member in self.members:
+            key = ("member", member.index)
+            if self._breaker.state(key) == CLOSED:
+                continue
+            verdict, _retry = self._breaker.admit(key)
+            if verdict != TRIAL:
+                continue
+            member.probes += 1
+            self._probes_c.inc()
+            ok = self._rebuild_member(member)
+            if ok:
+                self._breaker.record_success(key)
+                member.reinstates += 1
+                self._member_reinstated_c.inc()
+                self._note_group_success()
+                reinstated = True
+                if self._event_log is not None:
+                    self._event_log.emit(
+                        "shard.member_reinstated", request_id=None,
+                        family=None, group=self.name,
+                        member=member.index,
+                        incarnation=member.incarnation)
+            else:
+                self._breaker.record_failure(key)
+                self._rebuild_failures_c.inc()
+                self._note_group_failure(None)
+        if reinstated and not self._all_members_down():
+            # a serving member back up un-quarantines the group (its
+            # failure streak is over by construction)
+            self._note_group_success()
+        # group-level recovery: a group quarantined by UNATTRIBUTED
+        # cross-shard faults has no tripped member for the loop above
+        # to rebuild — and its shed traffic can never record a success.
+        # Probe the cross-shard session itself on the same cooldown
+        # cadence; a passing canary clears the group trip, a failing
+        # one buys another cooldown.
+        with self._state_lock:
+            opened = self._group_open_t
+        if opened is not None and all(
+                self._breaker.state(("member", m.index)) == CLOSED
+                for m in self.members):
+            if clock.now() - opened >= self.config.member_cooldown_s:
+                self._probes_c.inc()
+                if self._cross_canary():
+                    self._note_group_success()
+                    reinstated = True
+                else:
+                    with self._state_lock:
+                        self._group_open_t = clock.now()
+        self._recompute_state()
+        return reinstated
+
+    def _cross_canary(self) -> bool:
+        """A plain scan through the cross-shard session's own operator
+        stream (group-wide bracket: faults spanning any member fail
+        it)."""
+        try:
+            with self.lock, self._bracket(None), cancel_scope(None):
+                self.cross_graph.cypher(_CANARY_QUERY)
+            return True
+        except BaseException:
+            return False
+
+    def _rebuild_member(self, member: ShardMember) -> bool:
+        """Rebuild one member onto a spare/recovered device: a FRESH
+        session clone re-ingests the member's partitions from their
+        host slices (budget-bounded — cold ones stay on the host), then
+        the canary scan must pass ON that member's stream.  The swap is
+        atomic under the group lock; a failed rebuild leaves the old
+        state untouched."""
+        try:
+            fresh = self._member_session()
+            resident: "OrderedDict[int, Tuple[Any, int]]" = OrderedDict()
+            measured: Dict[int, int] = {}
+            with self.lock, self._bracket(member.index):
+                from caps_tpu.obs.ledger import tables_nbytes
+                budget = self.config.page_budget_bytes
+                used = 0
+                for pidx in member.partitions:
+                    cost = self._partition_cost(pidx)
+                    if resident and budget is not None \
+                            and used + cost > budget:
+                        continue
+                    graph = self.partitions[pidx].build(fresh)
+                    measured[pidx] = tables_nbytes(
+                        tuple(graph.node_tables)
+                        + tuple(graph.rel_tables))
+                    resident[pidx] = (graph, cost)
+                    used += cost
+                # the canary runs the rebuilt member's own operator
+                # stream: a fault scoped to this member fails it here
+                probe_graph = next(iter(resident.values()))[0]
+                with cancel_scope(None):
+                    probe_graph.cypher(_CANARY_QUERY)
+                member.session = fresh
+                member.resident = resident
+                member.measured_nbytes = measured
+                member.incarnation += 1
+                member.rebuilds += 1
+            self._rebuilds_c.inc()
+            return True
+        except BaseException:
+            return False
+
+    # -- maintenance thread (serving-mode background driver) -----------
+
+    def start_maintenance(self) -> None:
+        """Background maintenance loop for a RUNNING server: probes and
+        rebuilds happen off the serving path (a degraded group keeps
+        serving healthy shards while the victim rebuilds).  Tests drive
+        :meth:`maintenance_tick` directly on the fake clock instead."""
+        if self._maint_thread is not None:
+            return
+        self._maint_stop.clear()
+        t = threading.Thread(target=self._maintenance_loop,
+                             name=f"caps-tpu-shard-{self.name}",
+                             daemon=True)
+        self._maint_thread = t
+        t.start()
+
+    def _maintenance_loop(self) -> None:
+        nap = min(self.config.member_cooldown_s, 0.05)
+        while not self._maint_stop.is_set():
+            try:
+                if self.health() != GROUP_HEALTHY:
+                    self.maintenance_tick()
+            except Exception:  # pragma: no cover — must keep driving
+                pass
+            clock.wait(self._maint_stop, nap)
+
+    def close(self) -> None:
+        """Server shutdown: stop the maintenance loop and leave the
+        registry's live-group gauge set (a dead server's groups must
+        not keep reporting bytes)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._maint_stop.set()
+        t = self._maint_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        with _gauge_guard:
+            live = getattr(self._registry, "_shard_live_groups", [])
+            if self in live:
+                live.remove(self)
+
+    # -- reporting ------------------------------------------------------
+
+    def warmup_bindings(self) -> List[Dict[str, Any]]:
+        """Compile-charging bindings recorded ANYWHERE in the group
+        (member + cross sessions) — the plan-store collection seam: a
+        family served only by this group must still round-trip into the
+        persistent store so a cold process can warm it
+        (serve/warmup.py ``ServerWarmup.save``)."""
+        out: List[Dict[str, Any]] = []
+        seen: set = set()
+        for s in [m.session for m in self.members] + [self.cross_session]:
+            fn = getattr(s, "warmup_bindings", None)
+            if fn is None:
+                continue
+            for b in fn():
+                if b["family"] not in seen:
+                    seen.add(b["family"])
+                    out.append(b)
+        return out
+
+    def compiled_families(self) -> set:
+        """Plan families that compiled ANYWHERE in this group (member
+        sessions + the cross-shard session) — ``warmup_report()``'s
+        coverage input: a family warmed only on the group must count as
+        compiled."""
+        out: set = set()
+        for s in [m.session for m in self.members] + [self.cross_session]:
+            ledger = getattr(s, "compile_ledger", None)
+            if ledger is not None:
+                out.update(ledger.families())
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        with self._state_lock:
+            transitions = [dict(t) for t in self._transitions]
+            group_failures = self._group_failures
+        return {
+            "name": self.name,
+            "index": self.index,
+            "state": self.health(),
+            "partitions": len(self.partitions),
+            "partition_property": self.config.partition_property,
+            "cross_shard_meshed": self.cross_meshed,
+            "members": [dict(m.snapshot(),
+                             health=self.member_state(m.index))
+                        for m in self.members],
+            "group_failures": group_failures,
+            "transitions": transitions,
+            "paging": {
+                "budget_bytes": self.config.page_budget_bytes,
+                "resident_bytes": sum(m.resident_bytes()
+                                      for m in self.members),
+                "resident_device_bytes": sum(m.resident_device_bytes()
+                                             for m in self.members),
+                "host_bytes": self.cold_host_bytes(),
+                "faults": sum(m.page_faults for m in self.members),
+                "spills": sum(m.page_spills for m in self.members),
+            },
+            "requests": {"total": self.requests,
+                         "completed": self.completed,
+                         "failed": self.failed},
+        }

@@ -24,6 +24,13 @@ This module provides them:
   trickle (flaky device), injected ONLY into the replica whose
   ``executing_device_index()`` matches — other devices' operator
   streams never see them;
+* :func:`shard_loss` / :func:`sick_shard` — shard-SCOPED faults for the
+  shard-group serving tier (serve/shards.py): a member's death (or a
+  deterministic error trickle) injected ONLY into executions of the
+  targeted group that touch the targeted member — its own single-shard
+  stream plus the group-wide cross-shard programs that physically span
+  it, keyed by ``executing_shard()`` — so group-degradation tests are
+  deterministic and other members / plain replicas never see the fault;
 * :func:`flaky_ingest` — fail the first N table ingests of a session
   with a transient device error;
 * :func:`abort_write` — abort a versioned-graph commit after N delta
@@ -391,6 +398,93 @@ def sick_device(device_index: int, error_rate: float = 0.2,
         if budget.take():
             _count_injection("sick_device")
             raise _make_device_down(device_index)
+
+    with OPERATOR_PATCH.hooked(cls, hook):
+        yield budget
+
+
+def _make_shard_down(group: str, member: Optional[int]) -> BaseException:
+    """A fresh device-``UNAVAILABLE`` attributed to one shard-group
+    member (serve/shards.py): ``caps_device_fault`` makes the group's
+    ladder count it, ``caps_shard_member`` attributes the member so the
+    MEMBER breaker (not the group's) climbs."""
+    cls = xla_runtime_error_class()
+    exc = cls(f"UNAVAILABLE: shard member {member} of group {group!r} "
+              f"has halted; transport closed [injected shard loss]")
+    exc.caps_device_fault = True
+    if member is not None:
+        exc.caps_shard_member = member
+    return exc
+
+
+def _shard_scope_matches(group: str, member: Optional[int]) -> bool:
+    """True when the calling thread is executing inside the targeted
+    shard scope: the member's own bracket, or — because a dead device
+    also breaks every group-wide (cross-shard) program that spans it —
+    the group-wide bracket (member None)."""
+    from caps_tpu.serve.shards import executing_shard
+    scope = executing_shard()
+    if scope is None or scope[0] != group:
+        return False
+    if member is None:
+        return True
+    return scope[1] is None or scope[1] == member
+
+
+@contextlib.contextmanager
+def shard_loss(group: str, member: int, n_times: Optional[int] = None,
+               op_name: str = "Scan"):
+    """Kill ONE shard-group member: while active, every ``_compute`` of
+    the named operator raises a fresh member-attributed device
+    ``UNAVAILABLE`` — but ONLY inside executions of group ``group``
+    that touch member ``member``: the member's own single-shard stream,
+    AND the group-wide cross-shard programs (which physically span the
+    dead device).  Other groups, other members' single-shard streams,
+    and plain replica members never see it — the fault-domain isolation
+    the sharded soak asserts.
+
+    ``n_times=None`` is a permanent loss (the group must degrade and
+    keep serving its other shards); ``n_times=K`` is a K-shot glitch —
+    the background rebuild's canary after it heals the member (the
+    "recovered device" the ISSUE's rebuild path targets).  Yields the
+    injection budget (``.injected``)."""
+    cls = _resolve_operator(op_name)
+    budget = _Budget(n_times)
+
+    def hook(_op):
+        if not _shard_scope_matches(group, member):
+            return
+        if budget.take():
+            _count_injection("shard_loss")
+            raise _make_shard_down(group, member)
+
+    with OPERATOR_PATCH.hooked(cls, hook):
+        yield budget
+
+
+@contextlib.contextmanager
+def sick_shard(group: str, member: Optional[int] = None,
+               error_rate: float = 0.2, n_times: Optional[int] = None,
+               op_name: str = "Scan"):
+    """A flaky (not dead) shard scope: a deterministic ~``error_rate``
+    fraction of the named operator's executions inside group ``group``
+    (optionally narrowed to one ``member``) fail once with a transient
+    member-attributed device error — the same deterministic every-Nth
+    spacing as ``sick_device``, so a single retry through the server's
+    ladder always heals.  Yields the injection budget."""
+    if not 0.0 < error_rate <= 1.0:
+        raise ValueError(f"error_rate must be in (0, 1], got {error_rate}")
+    cls = _resolve_operator(op_name)
+    budget = _Budget(n_times, every_n=max(1, int(round(1.0 / error_rate))))
+
+    def hook(_op):
+        if not _shard_scope_matches(group, member):
+            return
+        if budget.take():
+            _count_injection("sick_shard")
+            from caps_tpu.serve.shards import executing_shard
+            scope = executing_shard()
+            raise _make_shard_down(group, scope[1] if scope else member)
 
     with OPERATOR_PATCH.hooked(cls, hook):
         yield budget

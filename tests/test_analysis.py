@@ -108,6 +108,44 @@ def outer():
     assert len(found) == 1 and "cycle" in found[0].message
 
 
+def test_lock_order_foreign_attr_may_alias(tmp_path):
+    """A foreign-attribute lock defined on SEVERAL classes (the
+    duck-typed execution seam: a ShardGroup standing in for a
+    DeviceReplica behind one ``member.lock`` call site) resolves to
+    EVERY candidate instead of being dropped — each alias keeps its
+    nesting edges, and no edge is fabricated BETWEEN the aliases."""
+    src = """\
+import threading
+
+_inner = threading.Lock()
+
+
+class Replica:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+
+class Group:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+
+def dispatch(member):
+    with member.lock:
+        with _inner:
+            pass
+"""
+    from caps_tpu.analysis.locks import static_lock_graph
+    p = _project(tmp_path, {"caps_tpu/serve/alias.py": src})
+    assert _findings(p, "lock-order") == []  # acyclic: clean
+    edges, _index, _info = static_lock_graph(p)
+    assert ("alias.Replica.lock", "alias._inner") in edges
+    assert ("alias.Group.lock", "alias._inner") in edges
+    # aliases of ONE runtime lock must not order against each other
+    assert ("alias.Replica.lock", "alias.Group.lock") not in edges
+    assert ("alias.Group.lock", "alias.Replica.lock") not in edges
+
+
 def test_lock_order_del_and_atexit_fire(tmp_path):
     src = """\
 import atexit
