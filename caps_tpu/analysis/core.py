@@ -94,14 +94,14 @@ class AnalysisConfig:
     #: exception is a mutation violation
     exception_markers: frozenset = frozenset({
         "caps_failed_op", "caps_device_index", "caps_transient",
-        "caps_device_fault", "caps_shard_member"})
+        "caps_device_fault", "caps_shard_member", "caps_wcoj_fault"})
     #: sanctioned first segments of dotted metric names
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
         "faults", "fused", "dist_join", "obs", "backend", "tracer",
         "updates", "compaction", "telemetry", "slo", "opstats",
         "compile", "mem", "slowlog", "warmup", "bucket", "planstore",
-        "cost", "stats", "replan", "shard", "paging"})
+        "cost", "stats", "replan", "shard", "paging", "wcoj"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)

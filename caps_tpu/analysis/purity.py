@@ -257,6 +257,24 @@ def _check_function(fn: ast.AST, reason: str, src: Source,
                            f"(.{node.func.attr}())")
 
 
+def traced_functions(project: Project) -> List[Tuple[str, str]]:
+    """(path, function name) of every function the purity closure
+    reaches — the discovered jit/pallas/fused-record root set plus its
+    same-module call closure.  Exposed so tests can assert REACHABILITY
+    (e.g. that a new kernel layer's probes are actually checked), not
+    just the absence of findings."""
+    cfg = project.config
+    out: List[Tuple[str, str]] = []
+    for src in project.sources:
+        roots = _collect_roots(src, cfg.purity_method_roots,
+                               cfg.purity_method_dirs)
+        if not roots:
+            continue
+        for _reason, fn in _closure(src, roots).values():
+            out.append((src.rel, fn.name))
+    return out
+
+
 @analysis_pass(PASS, "no clock reads, RNG, or module-state mutation "
                      "inside jit/shard_map/fused-record-path code")
 def check(project: Project) -> List[Finding]:

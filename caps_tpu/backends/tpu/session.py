@@ -21,6 +21,9 @@ class TPUCypherSession(RelationalCypherSession):
     # the local oracle stays on the join path so parity tests remain
     # independent
     supports_count_pushdown = True
+    # planner gate for the worst-case-optimal multiway join
+    # (relational/wcoj.py) — same oracle-independence rationale
+    supports_wcoj = True
 
     def __init__(self, config=None):
         super().__init__(config)

@@ -136,6 +136,10 @@ class DeviceBackend:
         # gathers, id domain) and per-(graph, plan, params) jitted closures.
         self.fused_count_static: Dict[int, dict] = {}
         self.fused_count_fns: Dict[tuple, tuple] = {}
+        # Worst-case-optimal multiway join (relational/wcoj.py): step
+        # shapes whose first launch already charged the compile ledger's
+        # ``wcoj`` kind — warmed shapes (and fused replays) charge zero.
+        self.wcoj_compiled_shapes: set = set()
         self.mesh = None
         self.axis = config.mesh_axis
         # degenerate leading axes collapse to a 1-D mesh so (1, 8) keeps

@@ -45,6 +45,181 @@ def _flip(d: Direction) -> Direction:
     return d  # BOTH is orientation-free
 
 
+# -- cyclic-segment analysis (shared with relational/wcoj.py) ----------------
+#
+# The generalization of count_pattern.py's CountCycleOp matcher from
+# count-only triangles to ARBITRARY cyclic MATCH shapes: a maximal
+# Filter*/Expand segment over one NodeScan(Start) whose Expands include
+# at least one ``into`` edge (both endpoints already bound — the closing
+# edge of a cycle).  The relational planner substitutes a worst-case-
+# optimal MultiwayJoinOp for the whole segment; this optimizer skips
+# chain re-rooting inside it (the WCOJ operator prices its own binding
+# anchors, so enumerating cascade orientations for a segment the
+# cascade will not execute is plan churn and a misleading EXPLAIN
+# decision line).
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeRef:
+    """One pattern edge in STORED orientation (``frm`` -> ``to`` is the
+    direction edges lie in the relationship table, regardless of how the
+    MATCH arrow was written)."""
+    rel: str
+    rel_types: Tuple[str, ...]
+    frm: str
+    to: str
+    closing: bool
+    intro: Opt[str]  # the node var this edge introduced (None if closing)
+
+
+@dataclasses.dataclass(frozen=True)
+class CyclicSegment:
+    scan: "L.NodeScan"
+    seed: str
+    order: Tuple[str, ...]               # binding order: seed + targets
+    labels: Tuple[Tuple[str, frozenset], ...]
+    edges: Tuple[EdgeRef, ...]           # plan order (bottom-up)
+    node_preds: Tuple[Tuple[str, Tuple[E.Expr, ...]], ...]
+    rel_preds: Tuple[Tuple[str, Tuple[E.Expr, ...]], ...]
+    uniq_pairs: Tuple[Tuple[str, str], ...]
+
+    def labels_of(self, var: str) -> frozenset:
+        return dict(self.labels).get(var, frozenset())
+
+
+def _split_conjuncts(pred: E.Expr) -> Tuple[E.Expr, ...]:
+    if isinstance(pred, E.Ands):
+        out: List[E.Expr] = []
+        for p in pred.exprs:
+            out.extend(_split_conjuncts(p))
+        return tuple(out)
+    return (pred,)
+
+
+def _uniqueness_pair(pred: E.Expr) -> Opt[Tuple[str, str]]:
+    """``NOT id(r1) = id(r2)`` — the relationship-isomorphism filter the
+    IR builder emits between pattern rels."""
+    if (isinstance(pred, E.Not) and isinstance(pred.expr, E.Equals)
+            and isinstance(pred.expr.lhs, E.Id)
+            and isinstance(pred.expr.rhs, E.Id)
+            and isinstance(pred.expr.lhs.entity, E.Var)
+            and isinstance(pred.expr.rhs.entity, E.Var)):
+        return (pred.expr.lhs.entity.name, pred.expr.rhs.entity.name)
+    return None
+
+
+def _plain_single_var(pred: E.Expr) -> Opt[str]:
+    """The single var a predicate reads, or None when it reads several /
+    none / contains a subquery (EXISTS patterns carry scope this
+    name-level analysis does not model)."""
+    vs = {v.name for v in E.vars_in(pred)}
+    if len(vs) != 1:
+        return None
+    stack: List[E.Expr] = [pred]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, E.ExistsSubQuery):
+            return None
+        stack.extend(c for c in x.children if isinstance(c, E.Expr))
+    return next(iter(vs))
+
+
+def match_cyclic_segment(head: "L.LogicalOperator") -> Opt[CyclicSegment]:
+    """Match the Filter*/Expand segment under (and including) ``head``
+    as a cyclic pattern: fixed single-orientation hops over one
+    ``NodeScan(Start)``, every non-into Expand growing from a bound var
+    to a NEW var, plus >= 1 ``into`` (closing) edge.  Predicates inside
+    the segment must be absorbable — single-var node/rel predicates or
+    rel-uniqueness pairs — because the substituted operator replaces the
+    whole subtree.  Returns None (cascade) for anything else."""
+    if not isinstance(head, L.Expand) or not head.into \
+            or head.direction == Direction.BOTH:
+        return None
+    filters: List[E.Expr] = []
+    expands: List[L.Expand] = []
+    cur: L.LogicalOperator = head
+    while True:
+        if isinstance(cur, L.Filter):
+            filters.extend(_split_conjuncts(cur.predicate))
+            cur = cur.parent
+        elif isinstance(cur, L.Expand):
+            if cur.direction == Direction.BOTH:
+                return None
+            expands.append(cur)
+            cur = cur.parent
+        elif isinstance(cur, L.NodeScan):
+            if not isinstance(cur.parent, L.Start) \
+                    or cur.parent.qgn is not None:
+                return None
+            scan = cur
+            break
+        else:
+            return None
+    expands.reverse()  # bottom-up: plan order
+
+    bound = {scan.var}
+    order: List[str] = [scan.var]
+    labels: Dict[str, frozenset] = {scan.var: frozenset(scan.labels)}
+    edges: List[EdgeRef] = []
+    rel_vars: set = set()
+    n_closing = 0
+    for e in expands:
+        if e.rel in rel_vars or e.rel in bound:
+            return None  # repeated rel var / rel-node name collision
+        frm, to = (e.source, e.target) \
+            if e.direction == Direction.OUTGOING else (e.target, e.source)
+        if e.into:
+            if not {e.source, e.target} <= bound:
+                return None
+            if e.target_labels and not (
+                    frozenset(e.target_labels)
+                    <= labels.get(e.target, frozenset())):
+                # labels restated on the closing mention must already be
+                # implied by the var's own binding (the operator masks
+                # each var once, at its scan)
+                return None
+            edges.append(EdgeRef(e.rel, tuple(sorted(set(e.rel_types))),
+                                 frm, to, closing=True, intro=None))
+            n_closing += 1
+        else:
+            if e.source not in bound or e.target in bound:
+                return None  # not a forward extension of the bound set
+            bound.add(e.target)
+            order.append(e.target)
+            labels[e.target] = frozenset(e.target_labels)
+            edges.append(EdgeRef(e.rel, tuple(sorted(set(e.rel_types))),
+                                 frm, to, closing=False, intro=e.target))
+        rel_vars.add(e.rel)
+    if n_closing == 0:
+        return None  # acyclic chain: the binary cascade is already fine
+    if rel_vars & bound:
+        return None
+
+    node_preds: Dict[str, List[E.Expr]] = {}
+    rel_preds: Dict[str, List[E.Expr]] = {}
+    uniq: List[Tuple[str, str]] = []
+    for p in filters:
+        pair = _uniqueness_pair(p)
+        if pair is not None and set(pair) <= rel_vars:
+            uniq.append(pair)
+            continue
+        var = _plain_single_var(p)
+        if var is None:
+            return None
+        if var in bound:
+            node_preds.setdefault(var, []).append(p)
+        elif var in rel_vars:
+            rel_preds.setdefault(var, []).append(p)
+        else:
+            return None
+    return CyclicSegment(
+        scan=scan, seed=scan.var, order=tuple(order),
+        labels=tuple(labels.items()), edges=tuple(edges),
+        node_preds=tuple((k, tuple(v)) for k, v in node_preds.items()),
+        rel_preds=tuple((k, tuple(v)) for k, v in rel_preds.items()),
+        uniq_pairs=tuple(uniq))
+
+
 class LogicalOptimizer:
     def __init__(self, cost_model=None):
         # Optional/ExistsSemiJoin rhs trees embed the lhs chain as a shared
@@ -185,6 +360,13 @@ class LogicalOptimizer:
         Optional/Exists subtrees are opaque (see class docstring)."""
         if isinstance(op, (L.Optional, L.ExistsSemiJoin)):
             return op
+        # NOTE: chains below a cyclic segment's closing edge still
+        # re-root here — the WCOJ substitution (relational/wcoj.py)
+        # consumes the REORDERED segment (a reversed chain is still a
+        # valid cyclic segment, rooted at the cheaper end), and when
+        # substitution does NOT happen (oracle sessions, wcoj priced
+        # out, use_wcoj off) the cascade must keep the PR 12 orientation
+        # optimization.
         if isinstance(op, (L.Filter, L.Expand)):
             matched, replacement = self._try_reverse(op)
             if matched:

@@ -77,6 +77,14 @@ class EngineConfig:
     # (VarExpandOp strategy "matrix") instead of the join cascade.
     use_ring: bool = dataclasses.field(
         default_factory=lambda: _env_bool("CAPS_TPU_USE_RING", True))
+    # Worst-case-optimal multiway joins (relational/wcoj.py, ROADMAP
+    # item 4): detected cyclic MATCH segments (chain + closing edges)
+    # substitute a leapfrog-style multiway intersection over sorted
+    # edge keys for the binary join cascade — enumeration AND counting.
+    # Cost-selected when the model is on; off = the cascade everywhere
+    # (the bench.py cyclic-mode baseline).
+    use_wcoj: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("CAPS_TPU_WCOJ", True))
     # Cost-based planning (relational/cost.py + relational/stats.py,
     # ROADMAP item 3): ingest-time cardinality/degree/skew sketches seed
     # a tensor-path cost model that (a) re-roots Expand chains at their

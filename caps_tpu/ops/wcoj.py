@@ -1,0 +1,214 @@
+"""Worst-case-optimal multiway-join primitives over sorted edge keys.
+
+Cyclic MATCH patterns (triangles, diamonds, k-cycles) compiled as binary
+join cascades materialize every *open* sub-pattern before the closing
+edge filters it — the classic intermediate blow-up the worst-case-
+optimal join literature (TrieJax, PAPERS.md; Ngo et al.) eliminates by
+intersecting all adjacency constraints *while* a new vertex binds.
+
+This module is the kernel layer of that path (relational/wcoj.py builds
+the operator on top).  Everything rides one physical structure:
+
+    key(e) = frm(e) * n + to(e)          (int64; n = node-id domain)
+
+sorted ascending — ONE device sort per (edge scan, orientation), routed
+through the engine's sort gate so it rides the live-validated bitonic
+sort kernel on TPU (ops/sort.py) and ``lax.sort`` elsewhere.  The sorted
+order gives both leapfrog views at once:
+
+* **adjacency**: the neighbours of ``u`` occupy the contiguous key range
+  ``[u*n, (u+1)*n)`` — and within it they are SORTED BY NEIGHBOUR ID,
+  the ordering guarantee leapfrog intersection needs (``probe_adj`` is
+  two ``searchsorted``s, no per-row scan);
+* **membership / multiplicity**: the parallel edges between a bound
+  pair ``(u, v)`` occupy ``[u*n+v, u*n+v]`` — ``probe_pair`` returns
+  their exact multiplicity and start offset, so a closing edge both
+  *semi-filters* candidates (count > 0) and later *enumerates* each
+  parallel edge as its own binding.
+
+Enumeration keeps the engine's pad-and-mask discipline: candidate
+expansion inverts ``cumsum(counts)`` through ``ops/expand.py``'s
+``expand_positions`` Pallas kernel (jnp twin off-TPU), output
+capacities are size-bucketed by the caller through the ``shapes.py``
+lattice, and validity is an exact live-row prefix — so every step is a
+fixed-shape device program and the whole pattern replays through the
+fused executor with zero host syncs beyond the consume seams.
+
+Dead rows fold their key to :data:`PAD_KEY` (sorts last, matches no
+probe).  All functions are pure jax (tracer-purity checked: they are
+jit roots for capslint's purity closure).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from caps_tpu.ops.expand import expand_positions, expand_positions_ref
+
+#: key sentinel for masked-out edges/ids: sorts after every real key
+#: (real keys are < n^2 <= 2^52 under the domain guard) and can never
+#: equal a probe key.
+PAD_KEY = jnp.int64(2) ** 62
+
+
+@jax.jit
+def edge_keys(frm: jnp.ndarray, to: jnp.ndarray, ok: jnp.ndarray,
+              n: jnp.ndarray) -> jnp.ndarray:
+    """Composite sort keys ``frm*n + to`` (int64), dead rows folded to
+    :data:`PAD_KEY`.  ``n`` is a traced scalar so one compiled program
+    serves every graph/domain size."""
+    n64 = jnp.asarray(n, jnp.int64)
+    k = frm.astype(jnp.int64) * n64 + to.astype(jnp.int64)
+    good = ok & (frm >= 0) & (to >= 0) & (frm < n64) & (to < n64)
+    return jnp.where(good, k, PAD_KEY)
+
+
+def sorted_edges(frm: jnp.ndarray, to: jnp.ndarray, ok: jnp.ndarray,
+                 n, sort_perm: Callable[[list], jnp.ndarray]
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(keys_sorted, perm): the one sorted structure both probes read.
+    ``sort_perm`` is the caller's gated sort (DeviceTable._sort_perm —
+    bitonic kernel on supported TPU capacities, lax.sort twin
+    otherwise), so the ordering guarantee is the sort kernel's."""
+    keys = edge_keys(frm, to, ok, jnp.int64(int(n)))
+    perm = sort_perm([keys])
+    return keys[perm], perm
+
+
+@jax.jit
+def sorted_ids(ids: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:
+    """Masked int64 id keys for a node scan (PAD-folded); the caller
+    sorts them through its gated sort like :func:`sorted_edges`."""
+    good = ok & (ids >= 0)
+    return jnp.where(good, ids.astype(jnp.int64), PAD_KEY)
+
+
+@jax.jit
+def probe_adj(keys_sorted: jnp.ndarray, u: jnp.ndarray, ok: jnp.ndarray,
+              n: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-probe-row (counts, lo) of u's neighbour segment
+    ``[u*n, (u+1)*n)`` — the leapfrog adjacency view: two searchsorteds
+    against the sorted keys, no gather, no per-row loop."""
+    n64 = jnp.asarray(n, jnp.int64)
+    in_dom = ok & (u >= 0) & (u < n64)
+    base = jnp.where(in_dom, u.astype(jnp.int64), 0) * n64
+    lo = jnp.searchsorted(keys_sorted, base, side="left")
+    hi = jnp.searchsorted(keys_sorted, base + n64, side="left")
+    counts = jnp.where(in_dom, hi - lo, 0)
+    return counts, lo
+
+
+@jax.jit
+def probe_pair(keys_sorted: jnp.ndarray, u: jnp.ndarray, v: jnp.ndarray,
+               ok: jnp.ndarray, n: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row (multiplicity, lo) of the exact pair key ``u*n + v`` —
+    the membership/closing view: multiplicity 0 semi-filters a
+    candidate, multiplicity k enumerates k parallel-edge bindings."""
+    n64 = jnp.asarray(n, jnp.int64)
+    in_dom = ok & (u >= 0) & (u < n64) & (v >= 0) & (v < n64)
+    q = jnp.where(in_dom, u.astype(jnp.int64) * n64 + v.astype(jnp.int64),
+                  PAD_KEY - 1)
+    lo = jnp.searchsorted(keys_sorted, q, side="left")
+    hi = jnp.searchsorted(keys_sorted, q, side="right")
+    counts = jnp.where(in_dom, hi - lo, 0)
+    return counts, lo
+
+
+@jax.jit
+def multiplicity(keys_sorted: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Multiplicity of raw composite keys ``q`` in the sorted table —
+    the probe CountCycleOp's batched 2-path counting specializes to."""
+    lo = jnp.searchsorted(keys_sorted, q, side="left")
+    hi = jnp.searchsorted(keys_sorted, q, side="right")
+    return (hi - lo).astype(jnp.int64)
+
+
+@jax.jit
+def probe_id(ids_sorted: jnp.ndarray, cand: jnp.ndarray, ok: jnp.ndarray
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-candidate (count, lo) against a sorted node-id table — the
+    node-scan membership check (labels + predicates pre-filtered by the
+    caller) that doubles as the id -> scan-row lookup via the sort
+    permutation."""
+    safe = jnp.where(ok & (cand >= 0), cand.astype(jnp.int64), PAD_KEY - 1)
+    lo = jnp.searchsorted(ids_sorted, safe, side="left")
+    hi = jnp.searchsorted(ids_sorted, safe, side="right")
+    counts = jnp.where(ok, hi - lo, 0)
+    return counts, lo
+
+
+def _positions(counts: jnp.ndarray, lo: jnp.ndarray, out_cap: int,
+               use_pallas: bool, interpret: bool
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    if use_pallas:
+        return expand_positions(counts, lo, out_cap, interpret=interpret)
+    return expand_positions_ref(counts, lo, out_cap)
+
+
+@jax.jit
+def _extend_gather(keys_sorted: jnp.ndarray, perm: jnp.ndarray,
+                   pos: jnp.ndarray, ok: jnp.ndarray, n: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Candidate neighbour id + original edge row for expanded slots."""
+    n64 = jnp.asarray(n, jnp.int64)
+    pos = jnp.clip(pos, 0, keys_sorted.shape[0] - 1)
+    key = keys_sorted[pos]
+    cand = jnp.where(ok & (key < PAD_KEY), key % n64, 0)
+    return cand, perm[pos]
+
+
+def extend(keys_sorted: jnp.ndarray, perm: jnp.ndarray, u: jnp.ndarray,
+           valid: jnp.ndarray, n, out_cap: int, *,
+           counts: Optional[jnp.ndarray] = None,
+           lo: Optional[jnp.ndarray] = None,
+           use_pallas: bool = False, interpret: bool = False
+           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One leapfrog extension: enumerate every (frontier row, incident
+    edge) pair along the anchor adjacency.
+
+    Returns ``(l_idx, cand, edge_row, ok)`` — the frontier row each
+    output slot came from, the new vertex candidate (the neighbour id,
+    read from the SORTED key segment), the anchor edge's scan row (the
+    relationship binding), and the exact live-prefix validity mask.
+    The caller semi-filters ``cand`` against the other incident edges
+    (:func:`probe_pair` counts) before compacting — intermediates never
+    exceed the true partial-match count plus this step's expansion.
+    ``counts``/``lo`` accept the :func:`probe_adj` results the caller
+    already computed to size ``out_cap`` (the hot path never probes the
+    same adjacency twice).
+    """
+    n64 = jnp.int64(int(n))
+    if counts is None or lo is None:
+        counts, lo = probe_adj(keys_sorted, u, valid, n64)
+    l_idx, pos, ok = _positions(counts, lo, out_cap, use_pallas, interpret)
+    cand, edge_row = _extend_gather(keys_sorted, perm, pos, ok, n64)
+    return l_idx, cand, edge_row, ok
+
+
+def close(keys_sorted: jnp.ndarray, perm: jnp.ndarray, u: jnp.ndarray,
+          v: jnp.ndarray, valid: jnp.ndarray, n, out_cap: int, *,
+          counts: Optional[jnp.ndarray] = None,
+          lo: Optional[jnp.ndarray] = None,
+          use_pallas: bool = False, interpret: bool = False
+          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Close one edge between two bound vertices: expand each frontier
+    row by the pair's parallel-edge multiplicity, binding each edge's
+    scan row.  Returns ``(l_idx, edge_row, ok)``; ``counts``/``lo``
+    reuse the caller's sizing :func:`probe_pair` like :func:`extend`."""
+    n64 = jnp.int64(int(n))
+    if counts is None or lo is None:
+        counts, lo = probe_pair(keys_sorted, u, v, valid, n64)
+    l_idx, pos, ok = _positions(counts, lo, out_cap, use_pallas, interpret)
+    pos = jnp.clip(pos, 0, perm.shape[0] - 1)
+    return l_idx, perm[pos], ok
+
+
+@jax.jit
+def adj_total(counts: jnp.ndarray) -> jnp.ndarray:
+    """Total expansion size of one step (the device scalar the caller
+    routes through ``backend.consume_rows`` before bucketing)."""
+    return counts.sum()

@@ -73,6 +73,11 @@ REORDER_MARGIN = 0.7
 #: observed mean overrides the model estimate
 _CALIBRATE_MIN_EXECUTIONS = 2
 
+#: modeled bytes per WCOJ frontier row: the multiway join's
+#: intermediates are narrow int columns (ids + scan rows), not the
+#: cascade's full-width materialized tables (relational/wcoj.py)
+WCOJ_ROW_BYTES = 8
+
 
 def choose_dist_strategy(probe_rows: int, build_rows: int, n_shards: int,
                          config, skew: float = 1.0
@@ -286,6 +291,79 @@ class CostModel:
                   cascade_cost=round(cascade_cost, 1))
         return decision
 
+    def closure_selectivity(self, rel_types: Iterable[str]) -> float:
+        """Expected multiplicity of edges of these types between two
+        SPECIFIC bound nodes — edge cardinality over the squared node
+        population.  Deliberately DIRECTION-FREE: a pair probe hits the
+        stored orientation whichever way the pattern arrow was written,
+        and the per-direction degree sketches (edge count over distinct
+        endpoints) overestimate pair existence badly on hub-skewed
+        graphs — exactly where the WCOJ win is largest.  This is the
+        semi-filter selectivity a closing edge applies the moment its
+        endpoints bind (the early filter the cascade defers)."""
+        n = max(1, self.stats.total_nodes)
+        return min(1.0, max(self.rel_scan_rows(rel_types), 1.0) / (n * n))
+
+    def wcoj_vs_cascade(self, seed_labels: Iterable[str], seed_sel: float,
+                        extends: Sequence[Tuple[Tuple[str, ...], Direction,
+                                                Iterable[str], float,
+                                                Sequence[Tuple[str, ...]]]],
+                        closes: Sequence[Tuple[str, ...]]
+                        ) -> Tuple[bool, float, Dict[str, Any]]:
+        """The WCOJ-vs-binary-cascade decision surface (ROADMAP item 4),
+        priced from the ingest-time degree/skew sketches.
+
+        ``extends`` is one entry per bound vertex beyond the seed:
+        ``(anchor_rel_types, anchor_direction, target_labels,
+        target_selectivity, checks)`` where ``checks`` lists the
+        rel-type tuples of the closing edges that semi-filter that
+        vertex's candidates at bind time; ``closes`` the rel-type
+        tuples of the pair-multiplicity closings.  The cascade pays the
+        full OPEN chain (every frontier materialized at ``ROW_BYTES``
+        width, closing joins applied only at the top); the multiway join
+        pays the same expansions at ``WCOJ_ROW_BYTES`` narrow width but
+        its frontiers shrink by ``closure_selectivity`` the moment a
+        closing edge's endpoints bind — on dense cyclic patterns the
+        intersection cost tracks the min-degree frontier while the
+        cascade's intermediates blow up super-linearly.
+
+        Returns ``(use_wcoj, estimated_output_rows, info)`` and logs the
+        decision for EXPLAIN (the ``wcoj_strategy`` line next to the
+        existing ``dist`` stamps)."""
+        hops = [(a_types, a_dir, t_labels, t_sel)
+                for a_types, a_dir, t_labels, t_sel, _checks in extends]
+        cascade_cost, ests = self.chain_cost(seed_labels, seed_sel, hops)
+        open_rows = ests[-1] if ests else 1.0
+        for rel_types in closes:
+            # one into-join (probe + pair filter) over the still-open
+            # frontier, then the closure selectivity finally applies
+            cascade_cost += 2.0 * self.device_cost(open_rows)
+            open_rows = max(1.0, open_rows
+                            * self.closure_selectivity(rel_types))
+        narrow = WCOJ_ROW_BYTES / float(ROW_BYTES)
+        rows = self.scan_rows(seed_labels) * max(seed_sel, 1e-9)
+        wcoj_cost = narrow * self.device_cost(rows)
+        for a_types, a_dir, t_labels, t_sel, checks in extends:
+            transient = rows * max(self.degree(a_types, a_dir), 1e-9)
+            wcoj_cost += narrow * self.device_cost(transient)
+            rows = (transient * self.stats.label_fraction(t_labels)
+                    * max(t_sel, 1e-9))
+            for c_types in checks:
+                rows *= self.closure_selectivity(c_types)
+            rows = max(rows, 1.0)
+            wcoj_cost += narrow * self.device_cost(rows)
+        for _rel_types in closes:
+            wcoj_cost += narrow * self.device_cost(rows)
+        est_rows = max(1.0, rows)
+        wcoj_cost += self.device_cost(est_rows)  # the one full-width gather
+        decision = wcoj_cost <= cascade_cost
+        info = {"wcoj_cost": round(wcoj_cost, 1),
+                "cascade_cost": round(cascade_cost, 1),
+                "est_rows": int(round(est_rows))}
+        self.note("wcoj_strategy",
+                  chosen="wcoj" if decision else "cascade", **info)
+        return decision, est_rows, info
+
     def dist_strategy(self, probe_rows: float, build_rows: float,
                       n_shards: int,
                       rel_types: Iterable[str] = ()
@@ -379,6 +457,7 @@ def annotate_plan(root, model: CostModel) -> Dict[str, Any]:
     from caps_tpu.relational import ops as R
     from caps_tpu.relational.count_pattern import CountPatternOp
     from caps_tpu.relational.var_expand import VarExpandOp
+    from caps_tpu.relational.wcoj import MultiwayJoinOp
 
     config = model.config
     n_shards = 0
@@ -430,6 +509,11 @@ def annotate_plan(root, model: CostModel) -> Dict[str, Any]:
             est = _scan_est(model, op)
         elif isinstance(op, CountPatternOp):
             est = 1.0
+        elif isinstance(op, MultiwayJoinOp):
+            # priced at plan time by wcoj_vs_cascade; the cascade child
+            # never executes on the healthy path, so its estimates do
+            # not flow up
+            est = max(1.0, float(op.planned_rows))
         elif isinstance(op, VarExpandOp):
             est, frontier = 0.0, l_est
             for length in range(1, op.upper + 1):

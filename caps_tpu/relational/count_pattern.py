@@ -84,6 +84,20 @@ class _Unsuitable(Exception):
     """Runtime bail-out: compute via the fallback join plan instead."""
 
 
+def _dense_bool_vec(okps, ends, n: int):
+    """Node indicator over the id domain from an id-sorted membership
+    mask: int32 cumsum + one boundary gather — the scatter-free
+    segment-sum (shared by the fused chain closure and the cycle op's
+    per-binding mask args)."""
+    import jax.numpy as jnp
+    if okps.shape[0] == 0:
+        return jnp.zeros((n,), bool)
+    c = jnp.cumsum(okps.astype(jnp.int32))
+    cum = jnp.where(ends >= 0, c[jnp.clip(ends, 0, None)], 0)
+    prev = jnp.concatenate([jnp.zeros(1, jnp.int32), cum[:-1]])
+    return (cum - prev) > 0
+
+
 def _walk_expr(e: E.Expr):
     """Every sub-expression of ``e`` (itself included)."""
     stack = [e]
@@ -417,14 +431,14 @@ class CountPatternOp(RelationalOperator):
     #   * per ITERATION: one program dispatch, zero host syncs.
 
     def _value_keyed(self) -> bool:
-        """True when the fused closure must key on parameter VALUES —
-        only shapes whose static structure bakes predicate results in
-        at build time (the cycle op's host-side compaction).  The plain
-        chain keys on the parameter SHAPE SIGNATURE instead
-        (relational/shapes.py): predicate masks rebuild per binding as
-        cheap eager args, the jitted program itself never recompiles —
-        so unseen bindings stop charging ``count_fused`` compiles (the
-        PR 10 cold-process residual)."""
+        """True when the fused closure must key on parameter VALUES.
+        No count-family op overrides this anymore (PR 12 converted the
+        main chain, PR 14 the cycle op — predicate masks rebuild per
+        binding as cheap eager args, the jitted programs never
+        recompile, so unseen bindings charge no ``count_fused``
+        compiles).  The only remaining value-keyed path is the
+        ``_shape_key``-failure fallback in ``_fused_total`` (parameter
+        values the shape signature cannot describe)."""
         return False
 
     def _shape_key(self, backend, params):
@@ -502,11 +516,13 @@ class CountPatternOp(RelationalOperator):
                 entry["args"] = args
                 entry["token"] = pk
         # roofline numerator: the device arrays the fused program reads
-        # per execution (this op has no evaluated children to account)
+        # per execution — the per-binding args PLUS any closure-captured
+        # static arrays the closure self-reports (the cycle op's batch
+        # probes re-read its resident edge/key tables every batch)
         import jax
         self._fused_bytes = sum(
             x.nbytes for x in jax.tree_util.tree_leaves(args)
-            if hasattr(x, "nbytes")) or getattr(fn, "nbytes_in", 0)
+            if hasattr(x, "nbytes")) + getattr(fn, "nbytes_in", 0)
         self.strategy = "fused-spmv"
         if fresh:
             # Compile ledger (obs/compile.py): a fused_count_fns miss is
@@ -768,14 +784,10 @@ class CountPatternOp(RelationalOperator):
         # gather at the destination.
 
         def dense_bool(okps, ends):
-            """Node indicator from id-sorted membership: int32 cumsum +
-            one boundary gather — the scatter-free segment-sum."""
-            if okps.shape[0] == 0:
-                return jnp.zeros((n,), bool)
-            c = jnp.cumsum(okps.astype(jnp.int32))
-            cum = jnp.where(ends >= 0, c[jnp.clip(ends, 0, None)], 0)
-            prev = jnp.concatenate([jnp.zeros(1, jnp.int32), cum[:-1]])
-            return (cum - prev) > 0
+            """Node indicator from id-sorted membership (module-level
+            :func:`_dense_bool_vec` — shared with the cycle op's
+            per-binding mask args)."""
+            return _dense_bool_vec(okps, ends, n)
 
         def hop_dense(x, frm, ok, ends, out_dtype):
             """One SpMV hop to a dense frontier of ``out_dtype``."""
@@ -1335,9 +1347,19 @@ class CountCycleOp(CountPatternOp):
     structurally: with no self-loop edges in any participating scan, the
     three matched rel instances are necessarily pairwise distinct (any
     coincidence forces a self-loop); graphs with self-loops fall back to
-    the join plan.  (Ref analog: Spark executes this query as a 5-way
+    the join plan — which for a cyclic pattern is now itself the
+    worst-case-optimal MultiwayJoinOp (relational/wcoj.py), not the raw
+    cascade.  (Ref analog: Spark executes this query as a 5-way
     shuffle-join cascade — reconstructed, mount empty; BASELINE.md
     config 4.)
+
+    This op is the AGGREGATE-ONLY specialization of the WCOJ path: the
+    closing probe is ``ops/wcoj.py``'s sorted pair-key multiplicity
+    (the close step with the enumeration skipped — multiplicities sum
+    instead of expanding), and since PR 14 the closure is SHAPE-keyed
+    like the main count path: node-predicate masks rebuild per unseen
+    binding as eager device args (``_cycle_mask_dev``), so cyclic count
+    families stop charging per-value ``count_fused`` compiles.
     """
 
     #: per-dispatch 2-path batch; one compile serves all batches
@@ -1354,15 +1376,6 @@ class CountCycleOp(CountPatternOp):
         return (super()._plan_sig(), "cycle",
                 tuple(sorted(set(ch.rel_types))), ch.direction)
 
-    def _value_keyed(self) -> bool:
-        """The cycle lowering bakes its (possibly param-dependent)
-        predicate masks into host-side static compaction at build time,
-        so predicated cycles stay VALUE-keyed; pred-free cycles are
-        fully static and share one shape-keyed closure."""
-        return bool(self.seed.preds
-                    or any(h.target.preds for h in self.hops)
-                    or self.close_hop.target.preds)
-
     def _compute_pushdown(self):
         fused = self._fused_total()
         if fused is None:
@@ -1370,29 +1383,25 @@ class CountCycleOp(CountPatternOp):
         self.strategy = "cycle-probe"
         return self._emit_fused(*fused)
 
-    def _cycle_mask(self, st, spec: NodeSpec, n: int):
-        """Dense HOST bool mask over the id domain for one node var
-        (existence + labels + predicates), evaluated once at build time."""
+    def _cycle_mask_dev(self, st, spec: NodeSpec, n: int, params):
+        """Dense DEVICE bool mask over the id domain for one node var
+        (existence + labels + predicates) — a pure function of graph
+        data + ``params``, rebuilt per unseen binding as cheap eager
+        device ops so the cycle closure stays SHAPE-keyed (the PR 10
+        cold-process residual, closed for the cycle family too)."""
         scan = self._fused_scan(st, spec.labels)
         if scan is None:
             return None
-        _header, _t, _ok, host_ids, host_ok = scan
-        if spec.preds:
-            order = np.arange(host_ids.shape[0])
-            okp = self._fused_okpred(scan, spec, order)
-            if okp is None:
-                return None
-            ok = np.asarray(okp)
-        else:
-            ok = host_ok
-        dense = np.zeros((n,), bool)
-        ids = host_ids[ok]
-        dense[ids[(ids >= 0) & (ids < n)]] = True
-        return dense
+        order, ends = self._fused_ids(st, spec.labels, n)
+        okps = self._fused_okpred(scan, spec, order, params)
+        if okps is None:
+            return None
+        return _dense_bool_vec(okps, ends, n)
 
     def _build_fused(self, backend, gk):
         import jax
         import jax.numpy as jnp
+        from caps_tpu.ops import wcoj as WC
         st = self._graph_static(backend, gk)
 
         h1, h2, ch = self.hops[0], self.hops[1], self.close_hop
@@ -1426,52 +1435,40 @@ class CountCycleOp(CountPatternOp):
         if n > _MAX_DOMAIN:
             return None
 
-        m_a = self._cycle_mask(st, self.seed, n)
-        m_b = self._cycle_mask(st, h1.target, n)
-        m_c = self._cycle_mask(st, h2.target, n)
-        if m_a is None or m_b is None or m_c is None:
-            return None
-
         def oriented(rel, direction):
             src, tgt, ok = rel
             return (src, tgt, ok) if direction == Direction.OUTGOING \
                 else (tgt, src, ok)
 
-        # hop 1 edges a->b, masked and compacted host-side (one-time)
+        # STATIC structures: validity-compacted only — node masks are
+        # per-BINDING arguments now, applied on the fly (a/b gate the
+        # 2-path weights, c gates inside the batch), so one compiled
+        # closure serves every parameter value of the shape.
         f1, t1, ok1 = oriented(rels[0], h1.direction)
-        keep1 = ok1 & m_a[np.clip(f1, 0, n - 1)] & m_b[np.clip(t1, 0, n - 1)]
-        e1f = f1[keep1].astype(np.int32)
-        e1t = t1[keep1].astype(np.int32)
+        e1f = np.clip(f1[ok1], 0, n - 1).astype(np.int32)
+        e1t = np.clip(t1[ok1], 0, n - 1).astype(np.int32)
 
-        # hop 2 CSR b->c (c-mask applied so the probe needs no mask)
+        # hop 2 CSR b->c (validity only; c-mask applied in the batch)
         f2, t2, ok2 = oriented(rels[1], h2.direction)
-        keep2 = ok2 & m_b[np.clip(f2, 0, n - 1)] & m_c[np.clip(t2, 0, n - 1)]
-        f2c = f2[keep2].astype(np.int64)
-        t2c = t2[keep2].astype(np.int32)
+        f2c = f2[ok2].astype(np.int64)
+        t2c = np.clip(t2[ok2], 0, n - 1).astype(np.int32)
         order2 = np.argsort(f2c, kind="stable")
         adj2 = t2c[order2]
         starts2 = np.searchsorted(f2c[order2], np.arange(n + 1, dtype=np.int64),
                                   side="left").astype(np.int64)
-        deg2 = (starts2[1:] - starts2[:-1]).astype(np.int64)
 
         # closing edge key table a*n + c (multiplicity-preserving)
         f3, t3, ok3 = oriented(rels[2], ch.direction)
         keys = (f3[ok3].astype(np.int64) * n + t3[ok3].astype(np.int64))
         keys = np.sort(keys)
 
-        W = deg2[np.clip(e1t, 0, n - 1)] if e1f.shape[0] else \
-            np.zeros((0,), np.int64)
-        cumW = np.cumsum(W, dtype=np.int64)
-        P = int(cumW[-1]) if cumW.shape[0] else 0
-
         cap1 = backend.bucket(1)
         valid = np.ones((cap1,), bool)
-        if P == 0 or keys.shape[0] == 0:
+        if e1f.shape[0] == 0 or keys.shape[0] == 0:
             zero = jnp.zeros((cap1,), jnp.int64)
-            return ((lambda: zero), (), valid, None)
+            return ((lambda *a: zero), (), valid, None)
 
         B = self._BATCH
-        d_cumW = backend.place_rows(jnp.asarray(cumW))
         d_e1f = backend.place_rows(jnp.asarray(e1f))
         d_e1t = backend.place_rows(jnp.asarray(e1t))
         d_starts2 = backend.place_rows(jnp.asarray(starts2))
@@ -1479,43 +1476,78 @@ class CountCycleOp(CountPatternOp):
             else jnp.zeros((1,), jnp.int32)
         d_keys = backend.place_rows(jnp.asarray(keys))
         n_i64 = jnp.int64(n)
-        P_i64 = jnp.int64(P)
+        # host loop extent for the current binding (set by build_args;
+        # not traced — the jitted batch program is P-generic)
+        cell = {"n_batches": 0, "P": 0}
 
         @jax.jit
-        def batch(p0):
+        def batch(p0, p_lim, m_c, cum_w):
             p = p0 + jnp.arange(B, dtype=jnp.int64)
-            live = p < P_i64
+            live = p < p_lim
             ps = jnp.where(live, p, 0)
-            j = jnp.searchsorted(d_cumW, ps, side="right")
-            j = jnp.minimum(j, d_cumW.shape[0] - 1)
-            prev = jnp.where(j > 0, d_cumW[jnp.maximum(j - 1, 0)], 0)
+            j = jnp.searchsorted(cum_w, ps, side="right")
+            j = jnp.minimum(j, cum_w.shape[0] - 1)
+            prev = jnp.where(j > 0, cum_w[jnp.maximum(j - 1, 0)], 0)
             k = ps - prev
             a = d_e1f[j].astype(jnp.int64)
             b = d_e1t[j].astype(jnp.int64)
             idx = jnp.minimum(d_starts2[b] + k, d_adj2.shape[0] - 1)
-            c = d_adj2[idx].astype(jnp.int64)
-            key = a * n_i64 + c
-            lo = jnp.searchsorted(d_keys, key, side="left")
-            hi = jnp.searchsorted(d_keys, key, side="right")
-            cnt = (hi - lo).astype(jnp.int64)
+            c = d_adj2[idx]
+            live = live & m_c[c]
+            key = a * n_i64 + c.astype(jnp.int64)
+            # sorted-pair multiplicity probe: the aggregate-only
+            # specialization of the WCOJ close step (ops/wcoj.py)
+            cnt = WC.multiplicity(d_keys, key)
             return jnp.where(live, cnt, 0).sum()
 
-        n_batches = (P + B - 1) // B
-
-        def run():
-            parts = [batch(jnp.int64(i * B)) for i in range(n_batches)]
+        def run(m_c, cum_w):
+            n_batches = cell["n_batches"]
+            if n_batches == 0:
+                return jnp.zeros((cap1,), jnp.int64)
+            p_lim = jnp.int64(cell["P"])
+            parts = [batch(jnp.int64(i * B), p_lim, m_c, cum_w)
+                     for i in range(n_batches)]
             total = parts[0]
             for x in parts[1:]:
                 total = total + x
             return jnp.zeros((cap1,), jnp.int64).at[0].set(total)
 
-        # roofline numerator: bytes each full execution reads (every batch
-        # probes the same resident arrays)
-        run.nbytes_in = n_batches * sum(
-            int(x.nbytes) for x in (d_cumW, d_e1f, d_e1t, d_starts2,
-                                    d_adj2, d_keys))
+        static_nbytes = sum(int(x.nbytes) for x in (d_e1f, d_e1t, d_starts2,
+                                                    d_adj2, d_keys))
+
+        def build_args(params):
+            """The parameter-dependent half: dense node masks + the
+            masked 2-path weight prefix sum, eager device ops (no XLA
+            compile, no count_fused charge).  One host scalar read (P)
+            sizes the batch loop — and re-stamps the roofline numerator
+            (``run.nbytes_in``: bytes every batch probes from the
+            resident static arrays, ADDED to the args accounting by
+            ``_fused_total``), so later bindings with different path
+            counts report honest per-execution bytes."""
+            m_a = self._cycle_mask_dev(st, self.seed, n, params)
+            m_b = self._cycle_mask_dev(st, h1.target, n, params)
+            m_c = self._cycle_mask_dev(st, h2.target, n, params)
+            if m_a is None or m_b is None or m_c is None:
+                return None
+            deg2 = d_starts2[d_e1t + 1] - d_starts2[d_e1t]
+            w = jnp.where(m_a[d_e1f] & m_b[d_e1t], deg2, 0)
+            cum_w = jnp.cumsum(w)
+            p_total = int(cum_w[-1])
+            cell["P"] = p_total
+            cell["n_batches"] = (p_total + B - 1) // B
+            run.nbytes_in = cell["n_batches"] * static_nbytes
+            return (m_c, cum_w)
+
+        args = build_args(self.context.parameters)
+        if args is None:
+            return None
         self.strategy = "cycle-probe"
-        return (run, (), valid, None)
+        all_preds = (list(self.seed.preds) + list(h1.target.preds)
+                     + list(h2.target.preds) + list(ch.target.preds))
+        has_param_preds = any(
+            isinstance(x, E.Param)
+            for p in all_preds for x in _walk_expr(p))
+        return (run, args, valid, build_args if has_param_preds else None)
 
     def _pretty_args(self):
         ch = self.close_hop

@@ -461,13 +461,18 @@ class RelationalPlanner:
 
     def _plan_expand(self, op: L.Expand) -> R.RelationalOperator:
         ctx = self.context
-        parent = self.plan_op(op.parent)
         rel_var = E.Var(op.rel)
         src_var = E.Var(op.source)
         tgt_var = E.Var(op.target)
         rel_ct = CTRelationship(op.rel_types)
 
         def branch(outgoing: bool, rel_name: str) -> R.RelationalOperator:
+            # parent planning lives INSIDE the branch (memoized, so the
+            # BOTH union's two branches still share one subtree): a WCOJ
+            # substitution must not plan the chain below it until the
+            # decision is made, or nested closing edges would substitute
+            # their own operators into what becomes this op's fallback
+            parent = self.plan_op(op.parent)
             rel_scan = R.ScanOp(ctx, self.current_graph, rel_name, rel_ct)
             rv = E.Var(rel_name)
             near = E.StartNode(rv) if outgoing else E.EndNode(rv)
@@ -480,10 +485,29 @@ class RelationalPlanner:
                                 CTNode(op.target_labels))
             return R.JoinOp(ctx, j1, tgt_scan, [(far, tgt_var)], "inner")
 
-        if op.direction == Direction.OUTGOING:
-            return branch(True, op.rel)
-        if op.direction == Direction.INCOMING:
-            return branch(False, op.rel)
+        if op.direction in (Direction.OUTGOING, Direction.INCOMING):
+            if op.into and not getattr(self, "_in_wcoj_fallback", False):
+                # cyclic pattern: a closing edge (both endpoints bound)
+                # roots a segment the worst-case-optimal multiway join
+                # can own (relational/wcoj.py) — cost-DECIDED before the
+                # cascade is built, and the embedded fallback cascade is
+                # built with nested substitution suppressed: ONE
+                # MultiwayJoinOp per segment, never a second one buried
+                # inside the fallback of the first (multi-closing-edge
+                # patterns would otherwise substitute per into-Expand)
+                from caps_tpu.relational.wcoj import try_plan_wcoj
+
+                def build_cascade():
+                    self._in_wcoj_fallback = True
+                    try:
+                        return branch(op.direction == Direction.OUTGOING,
+                                      op.rel)
+                    finally:
+                        self._in_wcoj_fallback = False
+                pushed = try_plan_wcoj(self, op, build_cascade)
+                if pushed is not None:
+                    return pushed
+            return branch(op.direction == Direction.OUTGOING, op.rel)
         # BOTH: union of the two orientations; exclude self-loops from the
         # second branch so each loop edge matches exactly once.
         out_b = branch(True, op.rel)

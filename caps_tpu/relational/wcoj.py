@@ -1,0 +1,526 @@
+"""Worst-case-optimal multiway joins for cyclic MATCH patterns.
+
+The binary join cascade the planner emits for a cyclic pattern —
+
+    MATCH (a)-[r1:K]->(b)-[r2:K]->(c), (a)-[r3:K]->(c) RETURN a, b, c
+
+— materializes every OPEN 2-path before the closing edge filters it:
+intermediates grow with frontier x degree per hop, super-linearly with
+pattern density, which is why rounds 3-5 had to hand-build the
+count-only ``CountCycleOp`` just to make triangle counting viable.
+:class:`MultiwayJoinOp` generalizes that analysis to arbitrary MATCH
+(enumeration, not just counts), substituting ONE operator for the whole
+detected cyclic segment (``logical/optimizer.py match_cyclic_segment``)
+that binds the pattern variable-at-a-time in the TrieJax/leapfrog style
+over the ``ops/wcoj.py`` sorted-edge layer:
+
+* each new vertex expands along ONE cost-chosen **anchor** adjacency
+  (the minimum-expected-degree incident edge — the leapfrog frontier),
+  riding the same ``expand_positions`` kernel the join path uses;
+* every OTHER incident pattern edge **semi-filters** the candidates
+  immediately (sorted pair-key membership), so after compaction the
+  frontier never exceeds the true partial-match count — the
+  intermediate blow-up the cascade pays simply never materializes;
+* the deferred edges then **close** by pair multiplicity, enumerating
+  each parallel edge as its own binding (openCypher semantics), and
+  relationship-isomorphism pairs absorbed from the segment's filters
+  drop rows whose rel bindings coincide;
+* finally each variable's scan columns are gathered once at the bound
+  rows — the only full-width materialization in the whole pattern.
+
+Established seams the operator rides:
+
+* **pad-and-mask**: every step is a fixed-shape program at a
+  ``shapes.py``-bucketed capacity with an exact live-row prefix, so the
+  whole pattern compiles once per bucket and replays param-generically
+  through the fused executor (sizes flow through ``consume_rows``);
+* **compile ledger**: first-seen step shapes charge a ``wcoj`` kind
+  (obs/compile.py) — warmed shapes and fused replays charge zero;
+* **snapshot delta overlay**: scans go through ``graph.scan_node`` /
+  ``scan_rel`` — the one seam that already serves masked base ∪ delta,
+  so live writes are visible with no extra plumbing;
+* **degraded fallback**: the embedded cascade child executes when the
+  device path is unsuitable (host tables, mesh-sharded session, huge id
+  domain) or FAULTS (``testing/faults.failing_wcoj``) — correctness
+  never depends on the fast path;
+* **cost model**: ``CostModel.wcoj_vs_cascade`` (relational/cost.py)
+  decides substitution from the ingest-time degree/skew sketches and
+  stamps the decision into EXPLAIN's cost section; the operator's
+  ``est_rows`` feeds ``opstats.divergences`` and the existing re-plan
+  loop.  ``EngineConfig.use_wcoj=False`` forces the cascade (the
+  ``bench.py cyclic`` baseline contract).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional as Opt, Tuple
+
+import numpy as np
+
+from caps_tpu.ir import exprs as E
+from caps_tpu.ir.pattern import Direction
+from caps_tpu.logical.optimizer import (
+    CyclicSegment, EdgeRef, match_cyclic_segment,
+)
+from caps_tpu.obs.compile import charged as _compile_charged
+from caps_tpu.relational.header import RecordHeader
+from caps_tpu.relational.ops import RelationalOperator, resolve_expr
+from caps_tpu.serve.errors import CancellationError as _CancellationError
+
+#: node-id domains above this refuse the composite-key form (keys are
+#: frm*n + to in int64; the guard keeps n^2 < 2^52 with headroom)
+_MAX_DOMAIN = 1 << 26
+
+
+class _Unsuitable(Exception):
+    """Runtime bail-out: serve this execution via the cascade child."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ExtendStep:
+    """Bind one new vertex: expand the ``anchor`` adjacency from
+    ``probe`` (the bound endpoint), semi-filter by every other incident
+    ``check`` edge."""
+    var: str
+    anchor: EdgeRef
+    probe: str
+    forward: bool  # probing along stored orientation (frm -> to)?
+    checks: Tuple[EdgeRef, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CloseStep:
+    edge: EdgeRef
+
+
+def plan_steps(seg: CyclicSegment, model=None
+               ) -> Tuple[List[ExtendStep], List[CloseStep]]:
+    """Assign each pattern edge a role under the plan-order binding
+    sequence: for every new vertex, the incident edges whose other
+    endpoint is already bound compete — the model's expected degree
+    picks the anchor (min-degree frontier, the leapfrog choice), the
+    rest semi-filter now and close later.  Without a model the
+    introducing edge anchors (the cascade's own order)."""
+    consumed: set = set()
+    extends: List[ExtendStep] = []
+    bound = {seg.seed}
+    for var in seg.order[1:]:
+        incident: List[Tuple[EdgeRef, str, bool]] = []
+        for e in seg.edges:
+            if e.rel in consumed or e.frm == e.to:
+                continue
+            if e.frm == var and e.to in bound:
+                incident.append((e, e.to, False))
+            elif e.to == var and e.frm in bound:
+                incident.append((e, e.frm, True))
+        if not incident:
+            raise ValueError(f"variable {var!r} has no bound anchor")
+
+        def score(item):
+            e, _probe, forward = item
+            if model is None:
+                return 0.0 if e.intro == var else 1.0
+            d = Direction.OUTGOING if forward else Direction.INCOMING
+            return model.degree(e.rel_types, d)
+
+        incident.sort(key=score)
+        anchor, probe, forward = incident[0]
+        consumed.add(anchor.rel)
+        checks = tuple(e for e, _p, _f in incident[1:])
+        extends.append(ExtendStep(var, anchor, probe, forward, checks))
+        bound.add(var)
+    closes = [CloseStep(e) for e in seg.edges if e.rel not in consumed]
+    return extends, closes
+
+
+def try_plan_wcoj(planner, op, build_fallback
+                  ) -> Opt["MultiwayJoinOp"]:
+    """Substitute a MultiwayJoinOp for the cyclic segment rooted at the
+    into-Expand ``op``, or None to keep the cascade.  Selection is
+    cost-based when the session carries a model; with the model off the
+    detected shape substitutes unconditionally (use_wcoj=False disables
+    both — the forced-cascade baseline).  ``build_fallback`` is a
+    zero-arg builder invoked only AFTER the decision to substitute (the
+    planner builds it with nested substitution suppressed, so one
+    segment yields one operator and a pure-cascade fallback)."""
+    session = planner.context.session
+    config = getattr(session, "config", None)
+    if not getattr(session, "supports_wcoj", False):
+        return None
+    if config is None or not getattr(config, "use_wcoj", False):
+        return None
+    seg = match_cyclic_segment(op)
+    if seg is None:
+        return None
+    model = planner.cost_model
+    try:
+        extends, closes = plan_steps(seg, model)
+    except ValueError:
+        return None
+    est_rows = 1.0
+    if model is not None:
+        node_preds = dict(seg.node_preds)
+
+        def sel(var: str) -> float:
+            return model.selectivity(node_preds.get(var, ()),
+                                     seg.labels_of(var))
+
+        ext_desc = []
+        for s in extends:
+            d = Direction.OUTGOING if s.forward else Direction.INCOMING
+            checks = tuple(c.rel_types for c in s.checks)
+            ext_desc.append((s.anchor.rel_types, d,
+                             seg.labels_of(s.var), sel(s.var), checks))
+        close_desc = [c.edge.rel_types for c in closes]
+        use, est_rows, _info = model.wcoj_vs_cascade(
+            seg.labels_of(seg.seed), sel(seg.seed), ext_desc, close_desc)
+        if not use:
+            return None
+    registry = getattr(session, "metrics_registry", None)
+    if registry is not None:
+        registry.counter("wcoj.substituted").inc()
+    out = MultiwayJoinOp(planner.context, build_fallback(),
+                         planner.current_graph,
+                         seg, tuple(extends), tuple(closes))
+    out.planned_rows = max(1.0, float(est_rows))
+    return out
+
+
+class MultiwayJoinOp(RelationalOperator):
+    """Enumerate all bindings of a cyclic pattern in one pass over
+    sorted edge keys (module docstring).  Child 0 is the binary join
+    cascade, evaluated lazily ONLY when the device path is unsuitable
+    or faults — the degraded-mode contract."""
+
+    def __init__(self, context, fallback: RelationalOperator, graph,
+                 seg: CyclicSegment, extends: Tuple[ExtendStep, ...],
+                 closes: Tuple[CloseStep, ...]):
+        super().__init__(context, [fallback])
+        self.graph = graph
+        self.seg = seg
+        self.extends = extends
+        self.closes = closes
+        self.strategy = "unplanned"
+        self.planned_rows: float = 1.0
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _compute(self):
+        registry = self._registry()
+        try:
+            out = self._compute_wcoj()
+            self.strategy = "wcoj"
+            if registry is not None:
+                registry.counter("wcoj.executions").inc()
+        except _Unsuitable:
+            # unsuitable shape/backend (host tables, mesh session,
+            # oversized domain): served by the cascade — counted, so
+            # a monitor can see the fast path is not running
+            if registry is not None:
+                registry.counter("wcoj.fallbacks").inc()
+            self.strategy = "fallback-cascade"
+            out = self.children[0].result
+        except _CancellationError:
+            raise  # budget expiry is the request's outcome, not a fault
+        except Exception:
+            # degraded mode: a faulting WCOJ path (injected or real)
+            # falls back to the binary cascade — the same answer, none
+            # of the suspect fast-path state
+            if registry is not None:
+                registry.counter("wcoj.fallbacks").inc()
+            self.strategy = "fallback-cascade"
+            out = self.children[0].result
+        self._metric_extra = {"strategy": self.strategy}
+        return out
+
+    def _registry(self):
+        session = getattr(self.context, "session", None)
+        return getattr(session, "metrics_registry", None)
+
+    # -- scan plumbing -----------------------------------------------------
+
+    def _filtered_scan(self, header, table, preds):
+        for pred in preds:
+            table = table.filter(resolve_expr(pred, header), header,
+                                 self.parameters)
+        return table
+
+    def _node_scan(self, var: str):
+        preds = dict(self.seg.node_preds).get(var, ())
+        header, t = self.graph.scan_node(var, self.seg.labels_of(var))
+        return header, t, self._filtered_scan(header, t, preds)
+
+    def _rel_scan(self, e: EdgeRef):
+        preds = dict(self.seg.rel_preds).get(e.rel, ())
+        header, t = self.graph.scan_rel(e.rel, e.rel_types)
+        return header, self._filtered_scan(header, t, preds)
+
+    # -- device path -------------------------------------------------------
+
+    def _compute_wcoj(self):
+        import jax.numpy as jnp
+        from caps_tpu import ops as OPS
+        from caps_tpu.backends.tpu import kernels as K
+        from caps_tpu.backends.tpu.table import DeviceTable, _gather_cols
+        from caps_tpu.ops import wcoj as W
+
+        backend = getattr(self.context.factory, "backend", None)
+        if backend is None:
+            raise _Unsuitable("no device backend")
+        if backend.mesh is not None:
+            # mesh-sharded (cross-shard) session: the okapi distributed
+            # joins own this layout — the cascade stays the executed
+            # plan there, digest-equal by construction
+            raise _Unsuitable("mesh-sharded session")
+        if not backend.config.use_wcoj:
+            raise _Unsuitable("use_wcoj disabled")
+        config = backend.config
+        use_pallas = bool(config.use_pallas and OPS.pallas_usable("prefetch"))
+        interpret = OPS.default_interpret()
+        seg = self.seg
+
+        def need_device(t):
+            if not isinstance(t, DeviceTable) or t.is_local:
+                raise _Unsuitable("host-fallback table")
+            return t
+
+        # scans: the one seam that already overlays snapshot deltas
+        node_parts: Dict[str, tuple] = {}
+        for var in seg.order:
+            header, _raw, t = self._node_scan(var)
+            need_device(t)
+            node_parts[var] = (header, t,
+                               t._cols[header.column(E.Var(var))])
+        rel_parts: Dict[str, tuple] = {}
+        for e in seg.edges:
+            header, t = self._rel_scan(e)
+            need_device(t)
+            v = E.Var(e.rel)
+            rel_parts[e.rel] = (
+                header, t,
+                t._cols[header.column(E.StartNode(v))],
+                t._cols[header.column(E.EndNode(v))],
+                t._cols[header.column(v)])
+
+        # id domain over everything the pattern touches
+        mx = jnp.int64(-1)
+        for _h, t, col in node_parts.values():
+            mx = jnp.maximum(mx, jnp.max(jnp.where(
+                col.valid & t.row_ok, col.data.astype(jnp.int64), -1)))
+        for _h, t, src, tgt, _idc in rel_parts.values():
+            ok = src.valid & tgt.valid & t.row_ok
+            mx = jnp.maximum(mx, jnp.max(jnp.where(
+                ok, src.data.astype(jnp.int64), -1)))
+            mx = jnp.maximum(mx, jnp.max(jnp.where(
+                ok, tgt.data.astype(jnp.int64), -1)))
+        n = backend.consume_count(mx, relation="cap") + 1
+        if n <= 0:
+            n = 1
+        if n > _MAX_DOMAIN:
+            raise _Unsuitable(f"node-id domain {n} too large")
+
+        def charged_shape(sig, fn):
+            """Compile-ledger seam: the FIRST launch of a wcoj step at a
+            new shape traces + XLA-compiles its programs — charge that
+            wall time under the ``wcoj`` kind; warmed shapes (and every
+            fused replay) charge nothing."""
+            seen = getattr(backend, "wcoj_compiled_shapes", None)
+            if seen is None:
+                seen = backend.wcoj_compiled_shapes = set()
+            if sig in seen:
+                return fn()
+            with _compile_charged("wcoj", shape=sig):
+                out = fn()
+            seen.add(sig)
+            return out
+
+        # sorted structures (memoized on stable scan columns — static
+        # graphs sort once, snapshot overlays and predicate-filtered
+        # scans rebuild per execution on their fresh columns)
+        def edge_structure(e: EdgeRef, forward: bool):
+            _h, t, src, tgt, _idc = rel_parts[e.rel]
+            frm_col, to_col = (src, tgt) if forward else (tgt, src)
+            key = (t._n, int(n), forward)
+            memo = getattr(frm_col, "_wcoj_edges", None)
+            if memo is not None and key in memo:
+                return memo[key]
+            ok = src.valid & tgt.valid & t.row_ok
+            res = charged_shape(
+                f"sort:b{t.capacity}",
+                lambda: W.sorted_edges(frm_col.data, to_col.data, ok, n,
+                                       t._sort_perm))
+            if memo is None:
+                memo = {}
+                try:
+                    frm_col._wcoj_edges = memo
+                except Exception:  # pragma: no cover — frozen columns
+                    return res
+            if len(memo) < 8:
+                memo[key] = res
+            return res
+
+        def node_structure(var: str):
+            _h, t, col = node_parts[var]
+            key = (t._n, int(n))
+            memo = getattr(col, "_wcoj_ids", None)
+            if memo is not None and memo[0] == key:
+                return memo[1]
+            keys = W.sorted_ids(col.data, col.valid & t.row_ok)
+            perm = charged_shape(f"sort:b{t.capacity}",
+                                 lambda: t._sort_perm([keys]))
+            ids_sorted = keys[perm]
+            dup = bool(np.asarray(
+                ((ids_sorted[:-1] == ids_sorted[1:])
+                 & (ids_sorted[:-1] < W.PAD_KEY)).any()))
+            res = (ids_sorted, perm, dup)
+            try:
+                col._wcoj_ids = (key, res)
+            except Exception:  # pragma: no cover
+                pass
+            return res
+
+        # frontier: per bound node var its id + scan row, per bound rel
+        # var its scan row — narrow int columns, the full-width gather
+        # happens exactly once, at the end
+        seed = seg.seed
+        _sh, st_, scol = node_parts[seed]
+        cap = st_.capacity
+        n_rows, live = st_._n, st_._live
+        state: Dict[tuple, object] = {
+            ("id", seed): jnp.where(scol.valid,
+                                    scol.data.astype(jnp.int64), -1),
+            ("row", seed): jnp.arange(cap, dtype=jnp.int32),
+        }
+
+        def prefix_mask():
+            m = jnp.arange(cap) < n_rows
+            if live is not None:
+                m = m & (jnp.arange(cap) < live)
+            return m
+
+        def compact(mask):
+            nonlocal state, cap, n_rows, live
+            count = K.mask_count(mask)
+            n_rows, live = backend.consume_rows(count)
+            out_cap = backend.bucket(n_rows)
+            idx = charged_shape(
+                f"compact:b{cap}x{out_cap}",
+                lambda: K.compact_indices(mask, out_cap)[0])
+            state = {k: v[idx] for k, v in state.items()}
+            cap = out_cap
+
+        for step in self.extends:
+            S, P = edge_structure(step.anchor, step.forward)
+            u_ids = state[("id", step.probe)]
+            valid = prefix_mask()
+            # the sizing probe is charged under its own shape (the first
+            # dispatch traces + compiles it) and its results feed the
+            # extend, which never probes the same adjacency twice
+            counts, lo_a = charged_shape(
+                f"adj:e{S.shape[0]}xb{cap}",
+                lambda: W.probe_adj(S, u_ids, valid, jnp.int64(n)))
+            total, t_live = backend.consume_rows(W.adj_total(counts))
+            out_cap = backend.bucket(total)
+            l_idx, cand, erow, ok = charged_shape(
+                f"extend:e{S.shape[0]}b{cap}x{out_cap}",
+                lambda: W.extend(S, P, u_ids, valid, n, out_cap,
+                                 counts=counts, lo=lo_a,
+                                 use_pallas=use_pallas,
+                                 interpret=interpret))
+            state = {k: v[l_idx] for k, v in state.items()}
+            state[("erow", step.anchor.rel)] = erow
+            cap, n_rows, live = out_cap, total, t_live
+            # node membership = existence + labels + predicates (the
+            # scan is pre-filtered); the sort perm doubles as id -> row
+            ids_sorted, perm_v, dup = node_structure(step.var)
+            if dup:
+                raise _Unsuitable("duplicate node ids in scan")
+            cnt_v, lo_v = charged_shape(
+                f"nid:n{ids_sorted.shape[0]}xb{cap}",
+                lambda: W.probe_id(ids_sorted, cand, ok))
+            keep = ok & (cnt_v > 0)
+            state[("id", step.var)] = cand
+            state[("row", step.var)] = perm_v[
+                jnp.clip(lo_v, 0, perm_v.shape[0] - 1)]
+            # leapfrog semi-filters: every other incident pattern edge
+            # must have at least one instance between the bound pair
+            for c in step.checks:
+                Sc, _Pc = edge_structure(c, True)
+                cntc, _ = charged_shape(
+                    f"pair:e{Sc.shape[0]}xb{cap}",
+                    lambda: W.probe_pair(Sc, state[("id", c.frm)],
+                                         state[("id", c.to)], keep,
+                                         jnp.int64(n)))
+                keep = keep & (cntc > 0)
+            compact(keep)
+
+        for step in self.closes:
+            e = step.edge
+            S, P = edge_structure(e, True)
+            valid = prefix_mask()
+            counts, lo_c = charged_shape(
+                f"pair:e{S.shape[0]}xb{cap}",
+                lambda: W.probe_pair(S, state[("id", e.frm)],
+                                     state[("id", e.to)], valid,
+                                     jnp.int64(n)))
+            total, t_live = backend.consume_rows(W.adj_total(counts))
+            out_cap = backend.bucket(total)
+            l_idx, erow, _ok = charged_shape(
+                f"close:e{S.shape[0]}b{cap}x{out_cap}",
+                lambda: W.close(S, P, state[("id", e.frm)],
+                                state[("id", e.to)], valid, n, out_cap,
+                                counts=counts, lo=lo_c,
+                                use_pallas=use_pallas,
+                                interpret=interpret))
+            state = {k: v[l_idx] for k, v in state.items()}
+            state[("erow", e.rel)] = erow
+            cap, n_rows, live = out_cap, total, t_live
+
+        if self.seg.uniq_pairs:
+            # relationship isomorphism absorbed from the segment's
+            # filters: rel bindings of the named pairs must differ
+            mask = prefix_mask()
+            for r1, r2 in self.seg.uniq_pairs:
+                id1 = rel_parts[r1][4].data[state[("erow", r1)]]
+                id2 = rel_parts[r2][4].data[state[("erow", r2)]]
+                mask = mask & (id1 != id2)
+            compact(mask)
+
+        # full-width materialization: gather each scan's columns once,
+        # headers concatenated in the cascade's own order so downstream
+        # operators see an identical layout
+        out_cols: Dict[str, object] = {}
+        headers = [node_parts[seed][0]]
+        out_cols.update(_gather_cols(node_parts[seed][1]._cols,
+                                     state[("row", seed)]))
+        for e in seg.edges:
+            headers.append(rel_parts[e.rel][0])
+            out_cols_e = _gather_cols(rel_parts[e.rel][1]._cols,
+                                      state[("erow", e.rel)])
+            if set(out_cols) & set(out_cols_e):
+                raise _Unsuitable("output column collision")
+            out_cols.update(out_cols_e)
+            if not e.closing:
+                headers.append(node_parts[e.intro][0])
+                out_cols_v = _gather_cols(node_parts[e.intro][1]._cols,
+                                          state[("row", e.intro)])
+                if set(out_cols) & set(out_cols_v):
+                    raise _Unsuitable("output column collision")
+                out_cols.update(out_cols_v)
+        out_header = headers[0]
+        for h in headers[1:]:
+            out_header = out_header.concat(h)
+        from caps_tpu.backends.tpu.table import DeviceTable as _DT
+        return out_header, _DT(backend, out_cols, n_rows, live=live)
+
+    # -- EXPLAIN -----------------------------------------------------------
+
+    def _pretty_args(self):
+        def edge(e: EdgeRef):
+            t = "|".join(e.rel_types)
+            tag = "*" if e.closing else ""
+            return f"({e.frm})-[{e.rel}:{t}]{tag}->({e.to})"
+
+        anchors = ",".join(f"{s.var}<~{s.anchor.rel}" for s in self.extends)
+        return (f"{' '.join(edge(e) for e in self.seg.edges)}, "
+                f"anchors=[{anchors}], strategy={self.strategy}")

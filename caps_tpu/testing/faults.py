@@ -111,8 +111,9 @@ def _resolve_operator(op_name: str) -> type:
     from caps_tpu.relational import count_pattern as CP
     from caps_tpu.relational import ops as R
     from caps_tpu.relational import var_expand as VE
+    from caps_tpu.relational import wcoj as WJ
     cls_name = op_name if op_name.endswith("Op") else op_name + "Op"
-    for mod in (R, CP, VE):
+    for mod in (R, CP, VE, WJ):
         cls = getattr(mod, cls_name, None)
         if isinstance(cls, type) and issubclass(cls, R.RelationalOperator):
             return cls
@@ -331,6 +332,47 @@ def failing_operator(op_name: str, exc: ExcSpec = None,
 
     with OPERATOR_PATCH.hooked(cls, hook):
         yield budget
+
+
+@contextlib.contextmanager
+def failing_wcoj(exc: ExcSpec = None, n_times: Optional[int] = 1):
+    """Fail the worst-case-optimal multiway join's DEVICE path
+    (relational/wcoj.py ``MultiwayJoinOp._compute_wcoj``) — the
+    degraded-ladder probe: the operator must catch the fault, count
+    ``wcoj.fallbacks``, and serve the SAME answer through its embedded
+    binary-cascade child, so tests of the fallback are deterministic
+    instead of hoping for a real device fault.
+
+    A FRESH exception per injection (``exc`` semantics as
+    :func:`failing_operator`; default a realistic device OOM), stamped
+    ``caps_wcoj_fault`` first-writer-wins at construction so assertions
+    can attribute what they caught.  ``n_times=1`` fails exactly the
+    next WCOJ execution then heals (the following execution must take
+    the fast path again); ``n_times=None`` is permanent (every cyclic
+    query serves via cascade).  Installed/restored on the shared fault
+    lock like every other patch point; injections count
+    ``faults.injected.wcoj``.  Yields the budget (``.injected``)."""
+    from caps_tpu.relational.wcoj import MultiwayJoinOp
+    budget = _Budget(n_times)
+
+    with OPERATOR_PATCH._lock:
+        orig = MultiwayJoinOp._compute_wcoj
+
+        def faulted(op_self):
+            if budget.take():
+                _count_injection("wcoj")
+                e = _fresh_exception(exc)
+                if getattr(e, "caps_wcoj_fault", None) is None:
+                    e.caps_wcoj_fault = True
+                raise e
+            return orig(op_self)
+
+        MultiwayJoinOp._compute_wcoj = faulted
+    try:
+        yield budget
+    finally:
+        with OPERATOR_PATCH._lock:
+            MultiwayJoinOp._compute_wcoj = orig
 
 
 def _make_device_down(device_index: int) -> BaseException:

@@ -282,6 +282,54 @@ def host_side():
     assert _findings(p, "tracer-purity") == []
 
 
+def test_purity_wcoj_kernel_clock_read_fires(tmp_path):
+    """The WCOJ kernel layer's jit roots are auto-discovered by the
+    purity closure: a clock read inside a wcoj-shaped probe (the exact
+    decorator/searchsorted structure of ops/wcoj.py) is flagged at its
+    line — the fixture proof that the new kernel functions sit in the
+    tracer-purity root set."""
+    src = """\
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def probe_adj(keys_sorted, u, ok, n):
+    drift = time.perf_counter()
+    base = u.astype(jnp.int64) * n
+    lo = jnp.searchsorted(keys_sorted, base, side="left")
+    hi = jnp.searchsorted(keys_sorted, base + n, side="left")
+    return jnp.where(ok, hi - lo, 0) + drift, lo
+
+
+def extend(keys_sorted, perm, u, ok, n, out_cap):
+    counts, lo = probe_adj(keys_sorted, u, ok, n)
+    return counts
+"""
+    p = _project(tmp_path, {"caps_tpu/ops/wcoj_fix.py": src})
+    found = _findings(p, "tracer-purity")
+    assert ("caps_tpu/ops/wcoj_fix.py", 9) in _lines(found)
+    # the un-jitted composition wrapper is NOT itself a root
+    assert all(line != 17 for _p, line in _lines(found))
+
+
+def test_purity_live_wcoj_kernels_are_roots():
+    """On the LIVE tree the ops/wcoj.py probes must be reached by the
+    purity closure (jit-decorated roots) — and clean (the repo-clean
+    test covers cleanliness; this asserts REACHABILITY, so a future
+    refactor dropping the jit decorators cannot silently un-check the
+    kernel layer)."""
+    from caps_tpu.analysis.purity import traced_functions
+    project = load_project(REPO)
+    reached = {(path, fn) for path, fn in traced_functions(project)}
+    wcoj_fns = {fn for path, fn in reached
+                if path.endswith("caps_tpu/ops/wcoj.py")}
+    assert {"probe_adj", "probe_pair", "multiplicity",
+            "probe_id", "edge_keys"} <= wcoj_fns, wcoj_fns
+
+
 def test_purity_fused_record_path_compute(tmp_path):
     src = """\
 from caps_tpu.obs import clock
