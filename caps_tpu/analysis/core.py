@@ -41,8 +41,8 @@ BANNED_TIME_READS = frozenset({
 DEFAULT_SERVE_MODULES = frozenset({
     "__init__.py", "admission.py", "batcher.py", "breaker.py",
     "compaction.py", "deadline.py", "devices.py", "errors.py",
-    "failure.py", "request.py", "retry.py", "server.py", "shards.py",
-    "warmup.py",
+    "failure.py", "fleet.py", "request.py", "retry.py", "router.py",
+    "server.py", "shards.py", "warmup.py", "wire.py",
 })
 
 
@@ -83,6 +83,10 @@ class AnalysisConfig:
     errors_rel: str = "caps_tpu/serve/errors.py"
     serve_error_base: str = "ServeError"
     expected_serve_modules: frozenset = DEFAULT_SERVE_MODULES
+    #: functions (defined in ``errors_rel``) whose return value is
+    #: always a ServeError — ``raise factory(...)`` satisfies E1 (the
+    #: wire layer rebuilds remote typed errors this way)
+    error_factories: frozenset = frozenset({"error_from_payload"})
     #: (rel path, function qualname) roots whose same-module call closure
     #: must reach a ``classify(...)`` call (the worker path routes every
     #: execution failure through the serve/failure.py taxonomy)
@@ -101,7 +105,8 @@ class AnalysisConfig:
         "faults", "fused", "dist_join", "obs", "backend", "tracer",
         "updates", "compaction", "telemetry", "slo", "opstats",
         "compile", "mem", "slowlog", "warmup", "bucket", "planstore",
-        "cost", "stats", "replan", "shard", "paging", "wcoj"})
+        "cost", "stats", "replan", "shard", "paging", "wcoj",
+        "fleet", "router", "wire"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)

@@ -9,7 +9,10 @@ extends it with the PR 4 invariants CHANGES.md only documented:
   hierarchy read from ``serve/errors.py`` + per-module imports /
   definitions).  ``__getattr__`` bodies are exempt (the attribute
   protocol requires AttributeError), bare ``raise`` / ``raise variable``
-  re-raises are out of scope (the ENGINE's error, not the tier's).
+  re-raises are out of scope (the ENGINE's error, not the tier's), and
+  ``raise factory(...)`` is sanctioned for the configured error
+  factories (``error_from_payload`` — the wire layer rebuilding a
+  remote typed error).
   The expected-modules pinning carries over: a serve module missing
   from the walk is a finding, not a silent skip.
 * **E2 — exceptions are never mutated**: an attribute assigned onto a
@@ -101,8 +104,9 @@ def _getattr_exempt_ids(tree: ast.AST) -> Set[int]:
 
 
 def _check_raises(src: Source, serve_errors: Set[str],
+                  factories: frozenset,
                   findings: List[Finding]) -> None:
-    ok_names = _module_error_names(src, serve_errors)
+    ok_names = _module_error_names(src, serve_errors) | set(factories)
     exempt = _getattr_exempt_ids(src.tree)
     for node in ast.walk(src.tree):
         if not isinstance(node, ast.Raise) or node.exc is None \
@@ -332,7 +336,7 @@ def check(project: Project) -> List[Finding]:
             "cannot be checked"))
         return findings
     for src in serve_sources:
-        _check_raises(src, serve_errors, findings)
+        _check_raises(src, serve_errors, cfg.error_factories, findings)
         _check_handlers(src, findings)
     # mutation discipline holds package-wide (ops.py stamps
     # caps_failed_op, failure.py stamps caps_device_index, ...)

@@ -295,3 +295,17 @@ def diff_snapshots(before: Dict[str, Any],
         else:
             out[k] = v
     return out
+
+
+def merge_snapshots(snaps: Sequence[Dict[str, Any]]) -> Dict[str, Number]:
+    """Sum numeric keys across per-process snapshots — the fleet-wide
+    aggregation behind one Prometheus scrape (serve/router.py
+    ``metrics_text``).  Counters and gauges add; non-numeric values are
+    dropped (per-process detail stays on the per-process scrape)."""
+    out: Dict[str, Number] = {}
+    for snap in snaps:
+        for k, v in snap.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            out[k] = out.get(k, 0) + v
+    return out

@@ -315,6 +315,53 @@ def _props_tuple(props: Mapping[str, Any]) -> Tuple[Tuple[str, Any], ...]:
     return tuple(sorted((k, v) for k, v in props.items() if v is not None))
 
 
+# -- delta-state serialization (the fleet replication unit) ------------------
+#
+# serve/fleet.py ships committed writes between processes as (delta
+# state, version) pairs: the owner serializes its current snapshot's
+# overlay, a peer rebuilds the same DeltaState and installs it at the
+# OWNER'S version (``VersionedGraph.install_state``).  Only the
+# host-level truth travels — delta tables, device buffers, and compiled
+# state rebuild locally on the peer (the "compiled state never
+# migrates" rule at process granularity).
+
+def delta_state_to_payload(state: DeltaState) -> Dict[str, Any]:
+    """JSON-able form of a delta overlay.  Property values must be
+    JSON-representable (the update vocabulary's literal subset);
+    ordering is canonical so equal states serialize identically."""
+    return {
+        "hidden_nodes": sorted(state.hidden_nodes),
+        "hidden_rels": sorted(state.hidden_rels),
+        "nodes": [[r.id, list(r.labels),
+                   [[k, v] for k, v in r.props]] for r in state.nodes],
+        "rels": [[r.id, r.src, r.tgt, r.rel_type,
+                  [[k, v] for k, v in r.props]] for r in state.rels],
+    }
+
+
+def delta_state_from_payload(payload: Mapping[str, Any]) -> DeltaState:
+    """The inverse of :func:`delta_state_to_payload`, validated — a
+    malformed payload raises :class:`UpdateError` (classified FATAL by
+    the serving tier) without touching any graph."""
+    try:
+        nodes = tuple(
+            _NodeRec(int(nid), tuple(str(lb) for lb in labels),
+                     tuple((str(k), v) for k, v in props))
+            for nid, labels, props in payload["nodes"])
+        rels = tuple(
+            _RelRec(int(rid), int(src), int(tgt), str(rel_type),
+                    tuple((str(k), v) for k, v in props))
+            for rid, src, tgt, rel_type, props in payload["rels"])
+        return DeltaState(
+            hidden_nodes=frozenset(int(i)
+                                   for i in payload["hidden_nodes"]),
+            hidden_rels=frozenset(int(i) for i in payload["hidden_rels"]),
+            nodes=nodes, rels=rels)
+    except (KeyError, TypeError, ValueError) as ex:
+        raise UpdateError(f"malformed delta-state payload: "
+                          f"{type(ex).__name__}: {ex}")
+
+
 class _OverlayLookup(_MappingABC):
     """Base entity lookup with hidden ids removed and delta entries
     overlaid — without copying the (potentially huge) base dict per
@@ -674,6 +721,40 @@ class VersionedGraph(RelationalCypherGraph):
         new_snap = GraphSnapshot(self._session, base, delta_graph, state,
                                  snap.snapshot_version + 1, handle=self)
         self._current = new_snap
+        return new_snap
+
+    def install_state(self, state: DeltaState, version: int
+                      ) -> GraphSnapshot:
+        """Replication seam (serve/fleet.py): adopt an OWNER process's
+        delta state at the owner's version — the peer half of snapshot
+        shipping.  The delta tables rebuild through THIS session's
+        factory (compiled state never ships), the new snapshot carries
+        the owner's ``snapshot_version`` verbatim, and the flip is the
+        same single atomic reference swap a local commit publishes
+        with, so readers keep snapshot isolation throughout.  Versions
+        at or behind the current snapshot are ignored (idempotent
+        re-ship, out-of-order delivery); the id allocator advances past
+        the shipped entities so a later owner promotion cannot collide."""
+        with self._lock:
+            snap = self._current
+            if version <= snap.snapshot_version:
+                return snap
+            pool = getattr(getattr(self._session, "backend", None),
+                           "pool", None)
+            mark = pool.mark() if pool is not None else None
+            try:
+                delta_graph = build_delta_graph(self._session, state)
+            except BaseException:
+                if pool is not None:
+                    pool.rollback(mark)
+                self._rolled_back.inc()
+                raise
+            new_snap = GraphSnapshot(self._session, snap.base, delta_graph,
+                                     state, version, handle=self)
+            self._current = new_snap
+            hi = max((r.id for r in state.nodes + state.rels), default=-1)
+            self._next_id = max(self._next_id, hi + 1)
+        self._evict_snapshot_plans(snap)
         return new_snap
 
     def _evict_snapshot_plans(self, old_snap: GraphSnapshot) -> None:

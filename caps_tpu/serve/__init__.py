@@ -38,6 +38,14 @@ The layer between many client threads and one engine session
                         families at start (explicit list or persistent
                         plan store — relational/plan_store.py), outcome
                         in stats()["warmup"] / health_report()
+    serve/wire.py       fleet wire protocol: length-prefixed JSON
+                        frames, typed-error round trip, WireClient
+    serve/fleet.py      fleet backends: one QueryServer per process
+                        behind a socket listener (in-process threads or
+                        spawned interpreters), snapshot export/install
+    serve/router.py     stateless consistent-hash router: plan-family
+                        affinity, load-aware spill, ring-degrading
+                        failover, snapshot shipping, fleet-wide scrape
 
 Engine hooks this package owns: ``RelationalCypherSession.cypher_batch``
 (one batched pass over a cached plan), the deadline checkpoints in
@@ -89,6 +97,19 @@ _LAZY = {
     "executing_shard": "caps_tpu.serve.shards",
     "ShardingUnsupported": "caps_tpu.serve.errors",
     "ShardMemberDown": "caps_tpu.serve.errors",
+    # fleet serving (serve/wire.py, serve/fleet.py, serve/router.py):
+    # multi-process scale-out behind a consistent-hash router
+    "WireError": "caps_tpu.serve.errors",
+    "FleetUnavailable": "caps_tpu.serve.errors",
+    "error_from_payload": "caps_tpu.serve.errors",
+    "WireClient": "caps_tpu.serve.wire",
+    "BackendSpec": "caps_tpu.serve.fleet",
+    "FleetBackend": "caps_tpu.serve.fleet",
+    "spawn_backend": "caps_tpu.serve.fleet",
+    "rows_digest": "caps_tpu.serve.fleet",
+    "HashRing": "caps_tpu.serve.router",
+    "RouterConfig": "caps_tpu.serve.router",
+    "FleetRouter": "caps_tpu.serve.router",
 }
 
 __all__ = [

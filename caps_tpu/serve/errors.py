@@ -6,19 +6,48 @@ load-shedding client retries after ``Overloaded.retry_after_s``, a
 deadline miss reports *which pipeline phase* consumed the budget
 (``DeadlineExceeded.phase``) so capacity planning can tell a planning
 stall from a device stall from queue pressure.
-"""
+
+**Wire fidelity.**  The fleet tier (serve/wire.py, serve/router.py)
+carries these errors between processes.  Every class serializes with
+:meth:`ServeError.to_payload` and reconstructs with
+:func:`error_from_payload` — EXACTLY: message, ``retry_after_s``,
+``attempts`` histories, phases, and budget fields all survive the JSON
+round trip, so a remote client's backoff and retry decisions are made
+from the same machine-usable fields a local caller would see
+(tests/test_fleet.py runs the parity matrix over every class here)."""
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 
 class ServeError(RuntimeError):
     """Base class for all serving-tier errors.
 
-    Invariant (enforced by ``scripts/check_serve_errors.py``): every
+    Invariant (enforced by the capslint error-taxonomy pass): every
     exception *constructed and raised* inside ``caps_tpu/serve/``
     inherits from this class, so a client needs exactly one except
     clause to catch everything the serving tier itself can signal."""
+
+    def to_payload(self) -> Dict[str, Any]:
+        """JSON-able wire form: class name, message, and every
+        machine-usable field (:meth:`_payload_fields`).  The inverse is
+        :func:`error_from_payload`."""
+        out: Dict[str, Any] = {"error": type(self).__name__,
+                               "message": str(self)}
+        out.update(self._payload_fields())
+        return out
+
+    def _payload_fields(self) -> Dict[str, Any]:
+        """Subclass hook: the constructor-relevant fields beyond the
+        message (must round-trip through JSON exactly)."""
+        return {}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "ServeError":
+        """Reconstruct from :meth:`to_payload` output.  The default
+        covers message-only constructors; field-carrying subclasses
+        override it to restore their exact machine-usable state."""
+        return cls(str(payload.get("message", "")))
 
 
 class ServerClosed(ServeError):
@@ -38,6 +67,18 @@ class Overloaded(ServeError):
         self.retry_after_s = retry_after_s
         self.queue_depth = queue_depth
         self.priority = priority
+
+    def _payload_fields(self) -> Dict[str, Any]:
+        return {"retry_after_s": self.retry_after_s,
+                "queue_depth": self.queue_depth,
+                "priority": self.priority}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "Overloaded":
+        return cls(str(payload.get("message", "")),
+                   retry_after_s=float(payload.get("retry_after_s", 0.0)),
+                   queue_depth=int(payload.get("queue_depth", 0)),
+                   priority=int(payload.get("priority", 0)))
 
 
 class WaitTimeout(ServeError, TimeoutError):
@@ -66,6 +107,17 @@ class QueryFailed(ServeError):
         self.attempts = tuple(attempts)
         self.retry_after_s = retry_after_s
 
+    def _payload_fields(self) -> Dict[str, Any]:
+        return {"attempts": [dict(a) for a in self.attempts],
+                "retry_after_s": self.retry_after_s}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "QueryFailed":
+        return cls(str(payload.get("message", "")),
+                   attempts=tuple(dict(a) for a in
+                                  payload.get("attempts", ())),
+                   retry_after_s=float(payload.get("retry_after_s", 0.0)))
+
 
 class CircuitOpen(QueryFailed):
     """Fast-fail: this request's plan family tripped its circuit breaker
@@ -75,6 +127,14 @@ class CircuitOpen(QueryFailed):
 
     def __init__(self, message: str, retry_after_s: float = 0.0):
         super().__init__(message, attempts=(), retry_after_s=retry_after_s)
+
+    def _payload_fields(self) -> Dict[str, Any]:
+        return {"retry_after_s": self.retry_after_s}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "CircuitOpen":
+        return cls(str(payload.get("message", "")),
+                   retry_after_s=float(payload.get("retry_after_s", 0.0)))
 
 
 class CompactionFailed(ServeError):
@@ -112,6 +172,15 @@ class ShardMemberDown(ServeError):
             #: member attribution for the group ladder (serve/shards.py)
             self.caps_shard_member = member
 
+    def _payload_fields(self) -> Dict[str, Any]:
+        return {"member": getattr(self, "caps_shard_member", None)}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "ShardMemberDown":
+        member = payload.get("member")
+        return cls(str(payload.get("message", "")),
+                   member=None if member is None else int(member))
+
 
 class CancellationError(ServeError):
     """Base of the two cooperative-cancel outcomes (deadline, explicit).
@@ -126,6 +195,14 @@ class CancellationError(ServeError):
         #: (queued | parse | plan | execute | materialize)
         self.phase = phase
 
+    def _payload_fields(self) -> Dict[str, Any]:
+        return {"phase": self.phase}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "CancellationError":
+        return cls(str(payload.get("message", "")),
+                   phase=str(payload.get("phase", "?")))
+
 
 class DeadlineExceeded(CancellationError):
     """The request's deadline expired; ``phase`` attributes the budget."""
@@ -139,6 +216,19 @@ class DeadlineExceeded(CancellationError):
         self.budget_s = budget_s
         self.elapsed_s = elapsed_s
 
+    def _payload_fields(self) -> Dict[str, Any]:
+        return {"phase": self.phase, "budget_s": self.budget_s,
+                "elapsed_s": self.elapsed_s}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "DeadlineExceeded":
+        # the message is deterministic from the fields, so rebuilding
+        # through the constructor reproduces it byte-for-byte
+        budget = payload.get("budget_s")
+        return cls(str(payload.get("phase", "?")),
+                   None if budget is None else float(budget),
+                   float(payload.get("elapsed_s", 0.0)))
+
 
 class Cancelled(CancellationError):
     """The client cancelled the request (``QueryHandle.cancel()``)."""
@@ -146,3 +236,71 @@ class Cancelled(CancellationError):
     def __init__(self, phase: str = "queued"):
         super().__init__(f"request cancelled in phase {phase!r}",
                          phase=phase)
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "Cancelled":
+        # message is derived from the phase — reconstruct, don't pass
+        return cls(phase=str(payload.get("phase", "queued")))
+
+
+class WireError(ServeError):
+    """A fleet wire-protocol transport failure (serve/wire.py): the
+    connection dropped mid-call, a frame was malformed or oversized, or
+    the peer closed before replying.  Marked ``caps_transient`` at
+    construction — the router's obligation under this error is to
+    degrade the backend's ring segment and retry the request on the
+    next ring node, exactly like the device ladder retries on a
+    different replica."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.caps_transient = True
+
+
+class FleetUnavailable(ServeError):
+    """The router exhausted every live ring node for a request (all
+    backends dead or overloaded).  ``retry_after_s`` carries the best
+    backoff hint observed along the way (the largest ``Overloaded``
+    hint, or 0.0 when the failures were connection-level)."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+    def _payload_fields(self) -> Dict[str, Any]:
+        return {"retry_after_s": self.retry_after_s}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "FleetUnavailable":
+        return cls(str(payload.get("message", "")),
+                   retry_after_s=float(payload.get("retry_after_s", 0.0)))
+
+
+def _error_classes() -> Dict[str, type]:
+    """Every ServeError subclass reachable from the base (this module
+    defines them all; subclasses registered elsewhere resolve too)."""
+    out: Dict[str, type] = {"ServeError": ServeError}
+    stack = [ServeError]
+    while stack:
+        cls = stack.pop()
+        for sub in cls.__subclasses__():
+            if sub.__name__ not in out:
+                out[sub.__name__] = sub
+                stack.append(sub)
+    return out
+
+
+def error_from_payload(payload: Dict[str, Any]) -> ServeError:
+    """The inverse of :meth:`ServeError.to_payload`: reconstruct the
+    exact typed error a remote process raised.  An unknown class name
+    (version skew across the fleet) degrades to a :class:`QueryFailed`
+    carrying the original class name in its message — never an
+    exception from here."""
+    if not isinstance(payload, dict):
+        return QueryFailed(f"malformed wire error payload: {payload!r}")
+    name = payload.get("error")
+    cls = _error_classes().get(name) if isinstance(name, str) else None
+    if cls is None:
+        return QueryFailed(f"unrecognized wire error {name!r}: "
+                           f"{payload.get('message', '')}")
+    return cls._rebuild(payload)

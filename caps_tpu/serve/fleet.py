@@ -1,0 +1,442 @@
+"""Fleet backends: one ``QueryServer`` per process behind a socket.
+
+A :class:`FleetBackend` wraps one server (its own session, graph, plan
+cache, warm-path store) in a TCP listener speaking the frame protocol
+of ``serve/wire.py``.  The router (serve/router.py) holds a
+:class:`~caps_tpu.serve.wire.WireClient` per backend and routes by
+consistent hash — compiled state never migrates between processes
+(docs/tpu.md), so scale-out ships *queries to the process whose caches
+are hot* and *snapshots to the processes whose graphs are stale*, never
+compiled artifacts.
+
+Two deployment shapes share this class:
+
+* **in-process** (tests, docs): ``FleetBackend(spec)`` starts the
+  server and listener on threads in the caller's process — real
+  sockets, real wire frames, deterministic and fast;
+* **multi-process** (bench, production shape): ``spawn_backend(spec)``
+  launches ``python -m caps_tpu.serve.fleet '<spec json>'`` — each
+  child owns a full interpreter (its own GIL), prints
+  ``CAPS_FLEET_PORT <port>`` on stdout, and serves until killed.
+
+Both build their graph from :class:`BackendSpec.graph` — a declarative
+spec (not a pickled object), so every process reconstructs an
+IDENTICAL base graph from the same JSON and snapshot shipping only has
+to move deltas (``relational/updates.py delta_state_to_payload``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import hashlib
+import os
+import random
+import socket
+import sys
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_lock
+from caps_tpu.serve import wire
+from caps_tpu.serve.errors import (QueryFailed, ReplicationUnsupported,
+                                   ServerClosed)
+from caps_tpu.serve.server import QueryServer, ServerConfig
+from caps_tpu.serve.warmup import WarmupConfig
+
+_UNSET = object()
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendSpec:
+    """Declarative description of one fleet backend — everything a
+    fresh process needs to reconstruct the same serving state."""
+
+    #: ring identity (stable across restarts — a rejoining process with
+    #: the same name reclaims the same ring segment)
+    name: str
+    #: session backend ("local" oracle or "tpu"); bench uses "local"
+    #: for child processes so scale-out is not dominated by per-process
+    #: jax compilation
+    backend: str = "local"
+    #: graph spec: ``{"kind": "script", "create": "..."}`` (a CREATE
+    #: statement through testing/factory), ``{"kind": "foaf",
+    #: "n_people": N, "n_edges": M, "seed": S}`` (deterministic social
+    #: graph — same seed → byte-identical base in every process), or
+    #: None for the empty ambient graph
+    graph: Optional[Dict[str, Any]] = None
+    #: wrap the graph in a VersionedGraph — required for the write
+    #: owner and every peer that pulls snapshots
+    versioned: bool = False
+    #: shared on-disk PlanStore path: a rejoining process warms from it
+    #: BEFORE taking traffic, and persists back on shutdown
+    plan_store_path: Optional[str] = None
+    #: background (True) vs inline (False) warmup; rejoin uses inline
+    #: so the port only opens once the hot set is compiled
+    warm_background: bool = False
+    workers: int = 2
+    max_queue: int = 256
+    default_deadline_s: Optional[float] = None
+    #: simulated per-query device dwell (seconds, via ``obs.clock``):
+    #: the CPU-smoke stand-in for a TPU-attached backend, where the
+    #: process WAITS on its device for most of a query's life.  Fleet
+    #: scale-out buys parallel devices, not parallel host CPUs — with a
+    #: dwell configured, QPS scaling across processes measures exactly
+    #: that serving-path parallelism, deterministically, even on a
+    #: single-core CI host.  0.0 (default) = serve at real speed.
+    service_dwell_s: float = 0.0
+    host: str = "127.0.0.1"
+    #: 0 = ephemeral (the listener reports the bound port)
+    port: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "BackendSpec":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        raw = json.loads(text)
+        return cls(**{k: v for k, v in raw.items() if k in fields})
+
+
+def foaf_create_script(n_people: int, n_edges: int, seed: int) -> str:
+    """Deterministic friend-of-a-friend CREATE statement.  Pure
+    function of its arguments (seeded Mersenne Twister — stable across
+    processes and Python builds), so every backend that parses it gets
+    an identical base graph."""
+    rng = random.Random(seed)
+    parts = [f"(p{i}:Person {{name: 'p{i}', age: {20 + (i * 7) % 50}}})"
+             for i in range(n_people)]
+    seen = set()
+    for _ in range(n_edges):
+        a = rng.randrange(n_people)
+        b = rng.randrange(n_people)
+        if a == b or (a, b) in seen:
+            continue
+        seen.add((a, b))
+        parts.append(f"(p{a})-[:KNOWS {{w: {rng.randrange(100)}}}]->(p{b})")
+    return "CREATE " + ",\n  ".join(parts)
+
+
+def build_graph_from_spec(session, gspec: Optional[Dict[str, Any]],
+                          versioned: bool):
+    """Construct the spec'd graph on ``session``.  Returns None for an
+    absent spec (the server then serves the ambient empty graph)."""
+    from caps_tpu.testing.factory import create_graph
+    if gspec is None:
+        base = None
+    else:
+        kind = gspec.get("kind", "script")
+        if kind == "script":
+            create = gspec.get("create")
+            if not create:
+                raise QueryFailed(
+                    "graph spec kind 'script' requires a non-empty "
+                    "'create' statement")
+            base = create_graph(session, create, gspec.get("parameters"))
+        elif kind == "foaf":
+            base = create_graph(session, foaf_create_script(
+                int(gspec.get("n_people", 64)),
+                int(gspec.get("n_edges", 256)),
+                int(gspec.get("seed", 0))))
+        else:
+            raise QueryFailed(f"unknown graph spec kind {kind!r}")
+    if versioned:
+        from caps_tpu.relational.updates import versioned as make_versioned
+        return make_versioned(session, base)
+    return base
+
+
+def rows_digest(rows) -> str:
+    """Order-insensitive content digest of materialized rows — the
+    cross-process read-your-writes check compares THIS, so two
+    backends agree exactly when their visible graph state agrees."""
+    canon = sorted(json.dumps(r, sort_keys=True, default=str)
+                   for r in rows)
+    return hashlib.sha256("\n".join(canon).encode("utf-8")).hexdigest()
+
+
+class FleetBackend:
+    """One serving process: a QueryServer behind a wire listener."""
+
+    def __init__(self, spec: BackendSpec, session=None, start: bool = True):
+        self.spec = spec
+        if session is None:
+            from caps_tpu.testing.sessions import make_backend_session
+            session = make_backend_session(spec.backend)
+        self.session = session
+        self.graph = build_graph_from_spec(session, spec.graph,
+                                           spec.versioned)
+        warmup = None
+        if spec.plan_store_path is not None:
+            warmup = WarmupConfig(store_path=spec.plan_store_path,
+                                  background=spec.warm_background,
+                                  save_on_shutdown=True)
+        self.server = QueryServer(
+            session, graph=self.graph,
+            config=ServerConfig(workers=spec.workers,
+                                max_queue=spec.max_queue,
+                                default_deadline_s=spec.default_deadline_s,
+                                warmup=warmup))
+        self._registry = session.metrics_registry
+        self._shutting_down = threading.Event()
+        self._conn_threads = []
+        self._conns = []
+        self._lock = make_lock("fleet.FleetBackend._lock")
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        if start:
+            self.start()
+
+    # -- listener ------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind + start accepting (idempotent).  Returns the bound
+        port.  When the spec asks for inline warmup the server
+        constructor already blocked on it — the port only opens warm."""
+        with self._lock:
+            if self._listener is not None:
+                return self.port
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind((self.spec.host, self.spec.port))
+            listener.listen(64)
+            self._listener = listener
+            self.port = listener.getsockname()[1]
+            self._registry.gauge("fleet.backend_up").set(1.0)
+            t = threading.Thread(target=self._accept_loop,
+                                 name=f"caps-fleet-{self.spec.name}",
+                                 daemon=True)
+            self._accept_thread = t
+            t.start()
+            return self.port
+
+    def _accept_loop(self) -> None:
+        while not self._shutting_down.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed — shutdown
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            self._registry.counter("fleet.connections").inc()
+            t = threading.Thread(
+                target=wire.serve_connection,
+                args=(conn, self.handle, self._shutting_down),
+                name=f"caps-fleet-conn-{self.spec.name}", daemon=True)
+            t.start()
+            self._conn_threads.append(t)
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop the listener, then the server (persisting warm state
+        when a store is configured).  Safe to call twice."""
+        self._shutting_down.set()
+        with self._lock:
+            listener, self._listener = self._listener, None
+        if listener is not None:
+            # shutdown() before close(): close() alone does NOT wake a
+            # thread blocked in accept() on the same socket
+            for fn in (lambda: listener.shutdown(socket.SHUT_RDWR),
+                       listener.close):
+                try:
+                    fn()
+                except OSError:  # pragma: no cover — teardown must not raise
+                    pass
+        # sever open connections like a dying process would: blocked
+        # peers observe EOF/reset (a WireError), not a hung socket
+        for conn in self._conns:
+            for fn in (lambda c=conn: c.shutdown(socket.SHUT_RDWR),
+                       conn.close):
+                try:
+                    fn()
+                except OSError:  # pragma: no cover — teardown must not raise
+                    pass
+        accept_thread = self._accept_thread
+        if accept_thread is not None and \
+                accept_thread is not threading.current_thread():
+            accept_thread.join(timeout=5.0)
+        for t in self._conn_threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        self._registry.gauge("fleet.backend_up").set(0.0)
+        self.server.shutdown(drain=drain)
+
+    # -- op dispatch ---------------------------------------------------
+
+    def handle(self, msg: Dict[str, Any]) -> Any:
+        """One request → one reply payload.  ServeErrors propagate (the
+        wire layer serializes them typed); anything else becomes a
+        QueryFailed on the wire."""
+        op = msg.get("op")
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            raise QueryFailed(f"unknown fleet op {op!r}")
+        self._registry.counter(f"fleet.ops.{op}").inc()
+        return fn(msg)
+
+    def _op_ping(self, msg) -> Dict[str, Any]:
+        return {"name": self.spec.name, "pid": os.getpid(),
+                "health": self.server.health(),
+                "snapshot_version": self._snapshot_version()}
+
+    def _snapshot_version(self) -> Optional[int]:
+        if getattr(self.graph, "graph_is_versioned", False):
+            return self.graph.current().snapshot_version
+        return None
+
+    def _submit(self, msg) -> Tuple[list, Dict[str, Any]]:
+        deadline = msg.get("deadline_s", _UNSET)
+        kwargs: Dict[str, Any] = {}
+        if deadline is not _UNSET:
+            kwargs["deadline_s"] = deadline
+        if msg.get("priority") is not None:
+            kwargs["priority"] = int(msg["priority"])
+        handle = self.server.submit(msg.get("query", ""),
+                                    msg.get("params") or {}, **kwargs)
+        rows = handle.rows()
+        return rows, handle.info
+
+    def _op_query(self, msg) -> Dict[str, Any]:
+        if self.spec.service_dwell_s > 0.0:
+            clock.sleep(self.spec.service_dwell_s)
+        rows, info = self._submit(msg)
+        out = {"rows": rows,
+               "ledger": info.get("ledger"),
+               "snapshot_version": info.get("snapshot_version"),
+               "queue_depth": self.server.admission.depth()}
+        if msg.get("digest"):
+            out["digest"] = rows_digest(rows)
+        return out
+
+    def _op_write(self, msg) -> Dict[str, Any]:
+        """An update query against the owned versioned graph; the reply
+        carries the post-commit version so the router can measure
+        snapshot lag per peer."""
+        if not getattr(self.graph, "graph_is_versioned", False):
+            raise ReplicationUnsupported(
+                f"backend {self.spec.name!r} serves a non-versioned "
+                f"graph; writes need a versioned owner")
+        rows, info = self._submit(msg)
+        return {"rows": rows,
+                "version": self.graph.current().snapshot_version,
+                "queue_depth": self.server.admission.depth()}
+
+    def _op_export_delta(self, msg) -> Dict[str, Any]:
+        """Replication source: the current snapshot's full delta state.
+        Deltas are cumulative over the shared base (the spec'd graph),
+        so one pull brings ANY stale peer exactly current — no
+        per-version chain to replay."""
+        from caps_tpu.relational.updates import delta_state_to_payload
+        if not getattr(self.graph, "graph_is_versioned", False):
+            raise ReplicationUnsupported(
+                f"backend {self.spec.name!r} serves a non-versioned "
+                f"graph; nothing to export")
+        snap = self.graph.current()
+        return {"version": snap.snapshot_version,
+                "state": delta_state_to_payload(snap.state)}
+
+    def _op_sync_from(self, msg) -> Dict[str, Any]:
+        """Replication sink: pull the owner's delta and flip the local
+        version atomically.  Monotonic — a concurrent newer local
+        version wins (install_state refuses to go backwards)."""
+        from caps_tpu.relational.updates import delta_state_from_payload
+        if not getattr(self.graph, "graph_is_versioned", False):
+            raise ReplicationUnsupported(
+                f"backend {self.spec.name!r} serves a non-versioned "
+                f"graph; cannot install snapshots")
+        with wire.WireClient(str(msg["host"]), int(msg["port"]),
+                             timeout_s=30.0) as owner:
+            delta = owner.call("export_delta")
+        state = delta_state_from_payload(delta["state"])
+        snap = self.graph.install_state(state, int(delta["version"]))
+        self._registry.counter("fleet.snapshots_installed").inc()
+        self._registry.gauge("fleet.snapshot_version").set(
+            float(snap.snapshot_version))
+        return {"version": snap.snapshot_version}
+
+    def _op_stats(self, msg) -> Dict[str, Any]:
+        return self.server.stats()
+
+    def _op_health(self, msg) -> Dict[str, Any]:
+        return {"health": self.server.health()}
+
+    def _op_health_report(self, msg) -> Dict[str, Any]:
+        return self.server.health_report()
+
+    def _op_metrics_snapshot(self, msg) -> Dict[str, Any]:
+        return self._registry.snapshot()
+
+    def _op_metrics_text(self, msg) -> str:
+        return self.server.metrics_text()
+
+    def _op_telemetry(self, msg) -> Dict[str, Any]:
+        return self.server.telemetry.summary()
+
+    def _op_warmup_report(self, msg) -> Dict[str, Any]:
+        return self.server.warmup_report(msg.get("families"))
+
+    def _op_warmup_wait(self, msg) -> Dict[str, Any]:
+        warmer = self.server.warmer
+        if warmer is None:
+            return {"state": "none", "done": True}
+        done = warmer.wait(msg.get("timeout"))
+        return {"state": warmer.report().get("state", "?"), "done": done}
+
+    def _op_shutdown(self, msg) -> Dict[str, Any]:
+        # reply first, then tear down from another thread — the client
+        # gets its ack before the socket dies
+        threading.Thread(target=self.shutdown,
+                         kwargs={"drain": bool(msg.get("drain", True))},
+                         name=f"caps-fleet-shutdown-{self.spec.name}",
+                         daemon=True).start()
+        return {"closing": True}
+
+
+# -- process entry point ----------------------------------------------
+
+
+def backend_main(spec_json: str) -> None:  # pragma: no cover — child
+    """Entry point of a spawned backend process: build the backend,
+    report the bound port on stdout, serve until killed."""
+    backend = FleetBackend(BackendSpec.from_json(spec_json))
+    print(f"CAPS_FLEET_PORT {backend.port}", flush=True)
+    try:
+        backend._shutting_down.wait()
+    except KeyboardInterrupt:
+        pass
+    backend.shutdown(drain=False)
+
+
+def spawn_backend(spec: BackendSpec, env: Optional[Dict[str, str]] = None):
+    """Launch ``python -m caps_tpu.serve.fleet`` with ``spec`` and wait
+    for its port line.  Returns ``(process, port)``; the caller owns
+    the process (terminate/kill/wait)."""
+    import subprocess
+    child_env = dict(os.environ)
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    # the child must import caps_tpu regardless of the caller's cwd:
+    # put the package's parent dir on its PYTHONPATH explicitly
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parent = os.path.dirname(pkg_root)
+    existing = child_env.get("PYTHONPATH")
+    child_env["PYTHONPATH"] = (
+        parent if not existing else parent + os.pathsep + existing)
+    if env:
+        child_env.update(env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "caps_tpu.serve.fleet", spec.to_json()],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=child_env, text=True)
+    line = proc.stdout.readline()
+    while line and not line.startswith("CAPS_FLEET_PORT"):
+        line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise QueryFailed(
+            f"fleet backend {spec.name!r} exited before reporting a port")
+    return proc, int(line.split()[1])
+
+
+if __name__ == "__main__":  # pragma: no cover — child process
+    backend_main(sys.argv[1])

@@ -1,0 +1,393 @@
+"""Fleet router: consistent-hash routing with load-aware spill.
+
+A thin, STATELESS process in front of N fleet backends
+(serve/fleet.py).  Routing is a consistent hash of ``(graph,
+plan-family key)`` over a virtual-node ring — the same family always
+lands on the same process, so plan caches, fused replay memos, and the
+warm-path store stay hot per process (the fleet-granularity version of
+"compiled state never migrates", docs/tpu.md).  The hash is
+``blake2b`` — stable across processes and Python builds, unlike the
+per-process-randomized builtin ``hash``.
+
+**Load-aware spill.**  Affinity must not let one hot family serialize
+the fleet (the JSPIM skew lesson): every reply piggybacks the
+backend's queue depth, and the router keeps a windowed view per
+backend.  When the primary's last-known depth crosses
+``RouterConfig.spill_queue_depth`` — or its SLO burn rate crosses
+``spill_burn_rate`` — overflow traffic walks to the next ring node
+instead of queueing behind the hot spot.  Spill is bounded: it walks
+the preference order, so a family's traffic concentrates on at most a
+few adjacent nodes rather than spraying the fleet cold.
+
+**Failover.**  A transport failure marks the backend dead and retries
+the SAME request on the next preference node — the ring segment
+degrades, nothing rehashes, and the surviving nodes' cache affinity is
+untouched (~1/N keys move is the consistent-hash contract, exercised
+in tests/test_fleet.py).  A rejoining process is pinged, waits for its
+PlanStore warmup, catches up on snapshots, and only then takes
+traffic again.
+
+**Writes** go to the single owner backend; the router then ships the
+owner's delta snapshot to every live peer (peers pull from the owner
+directly — the router only coordinates) and measures the lag
+(``fleet.snapshot_lag_s``): the read-your-writes bound a client
+observes across the whole fleet.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from caps_tpu.obs import clock
+from caps_tpu.obs.lockgraph import make_rlock
+from caps_tpu.obs.metrics import (MetricsRegistry, global_registry,
+                                  merge_snapshots)
+from caps_tpu.serve.errors import (FleetUnavailable, Overloaded, ServeError,
+                                   ServerClosed, WireError)
+from caps_tpu.serve.wire import WireClient
+
+_UNSET = object()
+
+
+def _ring_hash(key: str) -> int:
+    """Position on the 64-bit ring — blake2b, NOT the builtin ``hash``
+    (which is salted per process: two fleet members would disagree on
+    every placement)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(), "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    ``vnodes`` replicas per node smooth placement so each node owns
+    ~1/N of the key space; add/remove moves only the segments adjacent
+    to the changed node's vnodes (~1/N of keys)."""
+
+    def __init__(self, nodes: Sequence[str] = (), vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._points: List[Tuple[int, str]] = []
+        self._keys: List[int] = []
+        self._nodes: List[str] = []
+        for n in nodes:
+            self.add(n)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.append(node)
+        for i in range(self.vnodes):
+            h = _ring_hash(f"{node}#{i}")
+            at = bisect.bisect_left(self._points, (h, node))
+            self._points.insert(at, (h, node))
+        self._keys = [h for h, _ in self._points]
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.remove(node)
+        self._points = [(h, n) for h, n in self._points if n != node]
+        self._keys = [h for h, _ in self._points]
+
+    def nodes(self) -> Tuple[str, ...]:
+        return tuple(self._nodes)
+
+    def lookup(self, key: str) -> Optional[str]:
+        if not self._points:
+            return None
+        at = bisect.bisect_right(self._keys, _ring_hash(key))
+        if at == len(self._points):
+            at = 0
+        return self._points[at][1]
+
+    def preference(self, key: str, n: Optional[int] = None) -> List[str]:
+        """Distinct nodes in ring-walk order from ``key``'s position —
+        the failover/spill order.  Stable: removing a node leaves the
+        relative order of the others unchanged."""
+        if not self._points:
+            return []
+        want = len(self._nodes) if n is None else min(n, len(self._nodes))
+        out: List[str] = []
+        at = bisect.bisect_right(self._keys, _ring_hash(key))
+        for i in range(len(self._points)):
+            _h, node = self._points[(at + i) % len(self._points)]
+            if node not in out:
+                out.append(node)
+                if len(out) == want:
+                    break
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    #: virtual nodes per backend on the ring
+    vnodes: int = 64
+    #: spill when the primary's last-known queue depth reaches this
+    spill_queue_depth: int = 8
+    #: spill when the primary's fast SLO burn rate reaches this
+    #: (telemetry burn > 1.0 already eats budget faster than allowed)
+    spill_burn_rate: float = 4.0
+    #: distinct ring nodes tried per request before FleetUnavailable
+    max_attempts: int = 3
+    #: per-call wire timeout
+    timeout_s: float = 60.0
+
+
+class FleetRouter:
+    """Stateless request router over a set of fleet backends."""
+
+    def __init__(self, backends: Dict[str, Tuple[str, int]],
+                 owner: Optional[str] = None,
+                 config: Optional[RouterConfig] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        if not backends:
+            raise FleetUnavailable("router needs at least one backend")
+        self.config = config or RouterConfig()
+        self.registry = registry if registry is not None \
+            else global_registry()
+        self._addrs = dict(backends)
+        #: the single write owner (snapshot-shipping source); defaults
+        #: to the first backend in insertion order
+        self.owner = owner if owner is not None else next(iter(backends))
+        if self.owner not in self._addrs:
+            raise FleetUnavailable(f"owner {self.owner!r} is not a backend")
+        self.ring = HashRing(backends.keys(), vnodes=self.config.vnodes)
+        self._clients = {name: WireClient(host, port,
+                                          timeout_s=self.config.timeout_s)
+                         for name, (host, port) in self._addrs.items()}
+        self._state = {name: {"live": True, "depth": 0, "burn": 0.0}
+                       for name in self._addrs}
+        self._last_ship: Dict[str, Any] = {"version": None, "lag_s": None,
+                                           "peers": {}}
+        self._lock = make_rlock("router.FleetRouter._lock")
+        self._live_gauge = self.registry.gauge("fleet.backends_live")
+        self._live_gauge.set(float(len(self._addrs)))
+
+    # -- health bookkeeping --------------------------------------------
+
+    def _live_count(self) -> int:
+        return sum(1 for s in self._state.values() if s["live"])
+
+    def mark_dead(self, name: str) -> None:
+        with self._lock:
+            if not self._state[name]["live"]:
+                return
+            self._state[name]["live"] = False
+        self.registry.counter("router.backend_down").inc()
+        self._live_gauge.set(float(self._live_count()))
+        self._clients[name].close()
+
+    def rejoin(self, name: str, warm_timeout_s: Optional[float] = 30.0,
+               port: Optional[int] = None) -> Dict[str, Any]:
+        """Readmit ``name`` to its ring segment — but only after the
+        process proves it is actually ready: it answers a ping, its
+        PlanStore warmup has finished (a cold rejoin taking traffic
+        would compile on the client's clock), and its snapshot is
+        caught up with the write owner.  Returns the readiness report."""
+        with self._lock:
+            if port is not None:
+                host = self._addrs[name][0]
+                self._addrs[name] = (host, port)
+                self._clients[name].close()
+                self._clients[name] = WireClient(
+                    host, port, timeout_s=self.config.timeout_s)
+            client = self._clients[name]
+        info = client.call("ping")
+        warm = client.call("warmup_wait", timeout=warm_timeout_s)
+        synced = None
+        if name != self.owner and info.get("snapshot_version") is not None:
+            ohost, oport = self._addrs[self.owner]
+            try:
+                synced = client.call("sync_from", host=ohost, port=oport)
+            except ServeError:
+                self.registry.counter("fleet.ship_failures").inc()
+        with self._lock:
+            self._state[name] = {"live": True, "depth": 0, "burn": 0.0}
+        self.registry.counter("router.rejoined").inc()
+        self._live_gauge.set(float(self._live_count()))
+        return {"ping": info, "warmup": warm, "synced": synced}
+
+    def _note_reply(self, name: str, reply: Any) -> None:
+        if isinstance(reply, dict) and "queue_depth" in reply:
+            with self._lock:
+                self._state[name]["depth"] = int(reply["queue_depth"])
+
+    def note_burn(self, name: str, burn: float) -> None:
+        """Feed a backend's scraped SLO burn rate into spill decisions
+        (a health poller calls this from ``health_report``'s fast-burn
+        field)."""
+        with self._lock:
+            self._state[name]["burn"] = float(burn)
+
+    def _overloaded(self, name: str) -> bool:
+        s = self._state[name]
+        return (s["depth"] >= self.config.spill_queue_depth
+                or s["burn"] >= self.config.spill_burn_rate)
+
+    # -- read path -----------------------------------------------------
+
+    @staticmethod
+    def routing_key(graph: str, family: Optional[str], query: str) -> str:
+        """(graph, plan-family) — the cache-affinity unit.  ``family``
+        defaults to the query text, which IS the plan-family key for a
+        parameterized workload (parameters don't change the plan)."""
+        return f"{graph}|{family if family is not None else query}"
+
+    def query(self, query: str,
+              parameters: Optional[Dict[str, Any]] = None, *,
+              family: Optional[str] = None, graph: str = "default",
+              deadline_s: Any = _UNSET, priority: Optional[int] = None,
+              digest: bool = False) -> Dict[str, Any]:
+        """Route one read.  The reply dict carries ``rows`` plus the
+        backend's ledger/snapshot_version/queue_depth and the name it
+        ran on (``backend``).  Raises the backend's typed error
+        verbatim, or :class:`FleetUnavailable` when every candidate
+        ring node failed at the transport level."""
+        key = self.routing_key(graph, family, query)
+        prefs = self.ring.preference(key)
+        candidates = [n for n in prefs if self._state[n]["live"]]
+        if not candidates:
+            raise FleetUnavailable("no live backends on the ring")
+        if len(candidates) > 1 and self._overloaded(candidates[0]):
+            # bounded spill: overflow walks to the NEXT ring node — the
+            # hot family warms exactly one extra cache, not the fleet
+            self.registry.counter("router.spilled").inc()
+            candidates = candidates[1:] + candidates[:1]
+        candidates = candidates[:max(1, self.config.max_attempts)]
+        fields: Dict[str, Any] = {"query": query,
+                                  "params": parameters or {}}
+        if deadline_s is not _UNSET:
+            fields["deadline_s"] = deadline_s
+        if priority is not None:
+            fields["priority"] = priority
+        if digest:
+            fields["digest"] = True
+        hint = 0.0
+        for i, name in enumerate(candidates):
+            if i:
+                self.registry.counter("router.retries").inc()
+            try:
+                reply = self._clients[name].call("query", **fields)
+            except (WireError, ServerClosed):
+                # the process is gone (or lame-duck draining): degrade
+                # its ring segment and retry the request on the next
+                # node — in-flight work on a dead backend requeues here
+                self.mark_dead(name)
+                continue
+            except Overloaded as ex:
+                self._note_reply(name, {"queue_depth": ex.queue_depth})
+                hint = max(hint, ex.retry_after_s)
+                self.registry.counter("router.spilled").inc()
+                continue
+            self._note_reply(name, reply)
+            self.registry.counter("router.requests").inc()
+            if isinstance(reply, dict):
+                reply["backend"] = name
+            return reply
+        raise FleetUnavailable(
+            f"all {len(candidates)} candidate backends failed for "
+            f"key {key!r}", retry_after_s=hint)
+
+    # -- write path + snapshot shipping --------------------------------
+
+    def write(self, query: str,
+              parameters: Optional[Dict[str, Any]] = None, *,
+              ship: bool = True) -> Dict[str, Any]:
+        """Route one write to the owner, then ship its post-commit
+        snapshot to every live peer.  The reply carries the committed
+        ``version`` and the shipping report (per-peer version + lag)."""
+        if not self._state[self.owner]["live"]:
+            raise FleetUnavailable(
+                f"write owner {self.owner!r} is down — the fleet is "
+                f"read-only until it rejoins")
+        try:
+            reply = self._clients[self.owner].call(
+                "write", query=query, params=parameters or {})
+        except WireError:
+            self.mark_dead(self.owner)
+            raise FleetUnavailable(
+                f"write owner {self.owner!r} failed mid-write")
+        self._note_reply(self.owner, reply)
+        self.registry.counter("router.writes").inc()
+        if ship:
+            reply["ship"] = self.ship_snapshots()
+        return reply
+
+    def ship_snapshots(self) -> Dict[str, Any]:
+        """Bring every live peer current with the owner: each peer
+        pulls the owner's delta (peer→owner direct; the router only
+        coordinates) and flips its version atomically.  Records the
+        measured lag — commit-to-everywhere-visible — in
+        ``fleet.snapshot_lag_s``."""
+        ohost, oport = self._addrs[self.owner]
+        started = clock.now()
+        peers: Dict[str, Any] = {}
+        for name, state in list(self._state.items()):
+            if name == self.owner or not state["live"]:
+                continue
+            try:
+                out = self._clients[name].call("sync_from",
+                                               host=ohost, port=oport)
+                peers[name] = out.get("version")
+            except WireError:
+                self.registry.counter("fleet.ship_failures").inc()
+                self.mark_dead(name)
+            except ServeError:
+                # typed refusal (e.g. non-versioned peer) — the peer is
+                # alive, it just cannot replicate this graph
+                self.registry.counter("fleet.ship_failures").inc()
+        lag = clock.now() - started
+        self.registry.gauge("fleet.snapshot_lag_s").set(lag)
+        self.registry.counter("fleet.snapshots_shipped").inc(len(peers))
+        with self._lock:
+            self._last_ship = {"lag_s": lag, "peers": peers}
+        return {"lag_s": lag, "peers": peers}
+
+    # -- fleet-wide observability --------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            backends = {name: dict(state)
+                        for name, state in self._state.items()}
+        return {"owner": self.owner,
+                "ring_nodes": list(self.ring.nodes()),
+                "live": self._live_count(),
+                "backends": backends,
+                "last_ship": dict(self._last_ship)}
+
+    def snapshot_report(self) -> Dict[str, Any]:
+        """Owner + per-peer snapshot versions (a direct ping each) and
+        the last measured shipping lag."""
+        versions: Dict[str, Any] = {}
+        for name, state in self._state.items():
+            if not state["live"]:
+                continue
+            try:
+                versions[name] = self._clients[name].call(
+                    "ping").get("snapshot_version")
+            except WireError:
+                self.mark_dead(name)
+        return {"owner": self.owner,
+                "versions": versions,
+                "lag_s": self._last_ship.get("lag_s")}
+
+    def metrics_text(self) -> str:
+        """ONE Prometheus scrape for the whole fleet: the router's own
+        ``router.*``/``fleet.*`` series, plus every live backend's
+        registry snapshot summed across processes
+        (:func:`~caps_tpu.obs.metrics.merge_snapshots`)."""
+        snaps = []
+        for name, state in list(self._state.items()):
+            if not state["live"]:
+                continue
+            try:
+                snaps.append(self._clients[name].call("metrics_snapshot"))
+            except WireError:
+                self.mark_dead(name)
+        return self.registry.expose_text(extra=merge_snapshots(snaps))
+
+    def close(self) -> None:
+        for client in self._clients.values():
+            client.close()

@@ -306,6 +306,88 @@ def slow_compile(delay_s: float, n_times: Optional[int] = None,
 
 
 @contextlib.contextmanager
+def slow_network(delay_s: float, n_times: Optional[int] = None,
+                 every_n: int = 1):
+    """While active, fleet wire sends (``serve/wire.py send_frame``) are
+    deterministically slow: each eligible send sleeps ``delay_s``
+    through ``obs.clock`` before hitting the socket — on a fake clock a
+    "congested fleet link" costs zero real time, and router latency /
+    snapshot-lag assertions become exact.
+
+    Patches the MODULE attribute (both the backend's reply path and the
+    client's request path resolve ``wire.send_frame`` at call time, so
+    one patch point covers every direction) under the shared fault
+    lock; injections count ``faults.injected.slow_network``.  Yields
+    the budget (``.injected``)."""
+    from caps_tpu.serve import wire
+    budget = _Budget(n_times, every_n)
+
+    with OPERATOR_PATCH._lock:
+        orig = wire.send_frame
+
+        def slowed(sock, obj):
+            if budget.take():
+                _count_injection("slow_network")
+                clock.sleep(delay_s)
+            return orig(sock, obj)
+
+        wire.send_frame = slowed
+    try:
+        yield budget
+    finally:
+        with OPERATOR_PATCH._lock:
+            wire.send_frame = orig
+
+
+@contextlib.contextmanager
+def drop_connection(exc: ExcSpec = None, n_times: Optional[int] = 1,
+                    every_n: int = 1):
+    """While active, eligible fleet wire sends fail with a FRESH
+    connection-level error (default: ``ConnectionResetError``) instead
+    of reaching the socket — the deterministic stand-in for a backend
+    process dying mid-call.
+
+    The injected OSError surfaces exactly as the real path would —
+    wrapped into a transient :class:`~caps_tpu.serve.errors.WireError`
+    (what ``send_frame`` raises when ``sendall`` fails), counting a
+    ``wire.drops`` — so what the router must do next — degrade the
+    ring segment, retry the request on the next node — is exercised
+    without killing a real process.  ``n_times=1`` (the default) is
+    the canonical one-shot drop: the first affected call fails, the
+    failover lands, traffic continues.  Yields the budget
+    (``.injected``); injections count
+    ``faults.injected.drop_connection``."""
+    from caps_tpu.serve import wire
+    from caps_tpu.serve.errors import ServeError, WireError
+    if exc is None:
+        exc = ConnectionResetError("injected: connection dropped")
+    budget = _Budget(n_times, every_n)
+
+    with OPERATOR_PATCH._lock:
+        orig = wire.send_frame
+
+        def dropping(sock, obj):
+            if budget.take():
+                _count_injection("drop_connection")
+                err = _fresh_exception(exc)
+                if isinstance(err, ServeError):
+                    raise err
+                # the patch point sits where send_frame's own OSError
+                # conversion lives — surface the same typed shape
+                global_registry().counter("wire.drops").inc()
+                raise WireError(
+                    f"send failed: {type(err).__name__}: {err}")
+            return orig(sock, obj)
+
+        wire.send_frame = dropping
+    try:
+        yield budget
+    finally:
+        with OPERATOR_PATCH._lock:
+            wire.send_frame = orig
+
+
+@contextlib.contextmanager
 def failing_operator(op_name: str, exc: ExcSpec = None,
                      n_times: Optional[int] = None, every_n: int = 1):
     """While active, the named operator's ``_compute`` raises before

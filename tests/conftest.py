@@ -38,3 +38,26 @@ def make_session():
     """Session factory by backend name ('local' | 'tpu' | 'sharded')."""
     from caps_tpu.testing.sessions import make_backend_session
     return make_backend_session
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop jit/executable caches at every module boundary.
+
+    XLA:CPU's backend_compile segfaults once a single process has
+    accumulated a few hundred test files' worth of compiled programs
+    (reproduced on an unmodified tree: the full suite dies
+    deterministically inside jax's backend_compile at whichever
+    compile crosses the threshold, while the same test passes in
+    isolation).  Tests never rely on cross-module cache warmth — the
+    persistent-compile-cache tests use the on-disk cache, and
+    zero-compile replay assertions hold live references to their
+    executables, which clear_caches() does not invalidate — so a
+    boundary clear only costs per-module rewarming.
+    """
+    yield
+    try:
+        import jax
+        jax.clear_caches()
+    except Exception:  # pragma: no cover — cache clear is best-effort
+        pass

@@ -535,7 +535,12 @@ def test_catalog_mutation_concurrent_with_subscription_eviction(
 
     def querier():
         try:
-            while not stop.is_set():
+            # at least one query even if the mutator wins every
+            # timeslice (single-core schedulers can finish all 200
+            # mutations before this thread first runs)
+            ran_once = False
+            while not ran_once or not stop.is_set():
+                ran_once = True
                 graph.cypher(q, {"min": 20}).records.to_maps()
         except Exception as ex:  # pragma: no cover
             errors.append(ex)
