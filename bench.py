@@ -29,6 +29,12 @@ Modes: ``python bench.py``           config 1 (2-hop foaf)
        ``python bench.py serve``     config 5 (QueryServer load: closed-
                                      and open-loop, latency percentiles,
                                      batch and shed behavior)
+       ``python bench.py serve --cache``
+                                     config 11 (snapshot-keyed result
+                                     caching: Zipf-skewed repeated-read
+                                     soak cache-on vs cache-off, digest
+                                     parity, zero stale reads under
+                                     concurrent writes, budget bound)
        ``python bench.py serve --devices N``
                                      config 7 (device fault domains:
                                      serve QPS scaling 1 -> N replica
@@ -871,6 +877,216 @@ def run_cold_child(store_path: str, n_people: int, n_edges: int,
     }
     server.shutdown()
     print(json.dumps(out), flush=True)
+
+
+def run_serve_cache_config(on_tpu: bool):
+    """Benchmark config 11: snapshot-keyed result caching
+    (``serve --cache``, ISSUE 17).
+
+    Zipf-skewed repeated-read soak (8 closed-loop clients, skew ~1.1
+    over 32 distinct ``$seed`` bindings) against the SAME request
+    sequence twice — once with the result cache off, once on — then a
+    concurrent-writes phase on a versioned graph.  Asserted acceptance:
+
+    * hit ratio >= 0.8 on the skewed soak;
+    * p50 on cache hits >= 5x lower than the uncached p50;
+    * digest-exact parity: every cached answer equals the uncached
+      answer for the same binding (and the host oracle);
+    * zero stale reads while a writer commits concurrently — every
+      read's rows equal the serial state at its admission-time
+      snapshot version, with caching ON;
+    * ``rescache.bytes`` never exceeds the configured budget at any
+      sampled point;
+    * ``telemetry_qps`` uplift > 1x with the cache on.
+    """
+    import threading as _th
+    import numpy as np
+    from caps_tpu.backends.tpu.session import TPUCypherSession
+    from caps_tpu.relational.result_cache import ResultCacheConfig
+    from caps_tpu.relational.updates import versioned
+    from caps_tpu.serve import QueryServer, ServerConfig
+    from caps_tpu.serve.fleet import rows_digest
+    from caps_tpu.testing.factory import create_graph
+
+    _result.update({"metric": "result-cache hit ratio "
+                              "(no measurement completed)",
+                    "unit": "fraction", "value": 0.0})
+    rng = np.random.RandomState(42)
+    if on_tpu:
+        n_people, n_edges = 50_000, 250_000
+    else:
+        n_people, n_edges = 8_000, 40_000
+    n_people = int(os.environ.get("BENCH_N_PEOPLE", n_people))
+    n_edges = int(os.environ.get("BENCH_N_EDGES", n_edges))
+    session = TPUCypherSession()
+    graph, src, dst, names = build_graph(session, n_people, n_edges, 4,
+                                         rng)
+
+    # 32 distinct bindings; rank r drawn with p(r) ~ 1/(r+1)^1.1 — the
+    # repeated-read skew the cache exists for.
+    keys, seen = [], set()
+    for nm in names:
+        if nm not in seen:
+            seen.add(nm)
+            keys.append(nm)
+        if len(keys) == 32:
+            break
+    exp = expected_paths(src, dst, names, keys)
+    clients = 8
+    per_client = int(os.environ.get("BENCH_CACHE_REQS", "40"))
+    total = clients * per_client
+    w = 1.0 / np.power(np.arange(1, len(keys) + 1), 1.1)
+    ranks = rng.choice(len(keys), size=total, p=w / w.sum())
+    sequence = [keys[r] for r in ranks]
+
+    prep = session.prepare(PARAM_QUERY, graph=graph)
+    for nm in keys:  # warm plan + fused caches: steady-state baseline
+        assert prep.run({"seed": nm}).records.to_maps()[0]["c"] == exp[nm]
+
+    digests, dig_lock = {}, _th.Lock()
+
+    def soak(server, record_hits):
+        latencies, hit_lat, hits, errors = [], [], [], []
+
+        def client(i):
+            try:
+                for j in range(per_client):
+                    seed = sequence[i * per_client + j]
+                    h = server.submit(PARAM_QUERY, {"seed": seed})
+                    rows = h.rows(timeout=60)
+                    assert rows[0]["c"] == exp[seed], (seed, rows)
+                    d = rows_digest(rows)
+                    with dig_lock:
+                        if seed in digests:  # parity across runs AND hits
+                            assert digests[seed] == d, seed
+                        else:
+                            digests[seed] = d
+                        latencies.append(h.info["latency_s"])
+                        if h.info.get("cache") == "hit":
+                            hits.append(1)
+                            hit_lat.append(h.info["latency_s"])
+                        if record_hits and server.result_cache is not None:
+                            assert (server.result_cache.bytes
+                                    <= server.result_cache.config
+                                    .budget_bytes), "budget exceeded"
+            except Exception as ex:
+                errors.append(repr(ex))
+
+        threads = [_th.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        qps = server.health_report()["window"]["qps"]
+        return latencies, hit_lat, len(hits), errors, elapsed, qps
+
+    # -- phase 1: cache OFF (the device-dwell baseline) ----------------
+    off = QueryServer(session, graph=graph, config=ServerConfig(
+        workers=2, max_queue=4096, max_batch=16, batch_window_s=0.001))
+    off_lat, _hl, off_hits, off_err, off_s, off_qps = soak(off, False)
+    off.shutdown()
+    assert off_hits == 0 and not off_err, (off_hits, off_err[:3])
+
+    # -- phase 2: cache ON, identical sequence -------------------------
+    budget = 4 << 20
+    on = QueryServer(session, graph=graph, config=ServerConfig(
+        workers=2, max_queue=4096, max_batch=16, batch_window_s=0.001,
+        result_cache=ResultCacheConfig(budget_bytes=budget)))
+    on_lat, hit_lat, n_hits, on_err, on_s, on_qps = soak(on, True)
+    rstats = on.result_cache.stats()
+    assert not on_err, on_err[:3]
+    hit_ratio = n_hits / total if total else 0.0
+    p50_off = _percentiles(off_lat).get("p50_s", 0.0)
+    p50_hit = _percentiles(hit_lat).get("p50_s", 0.0)
+    assert hit_ratio >= 0.8, f"hit ratio {hit_ratio:.3f} < 0.8"
+    assert p50_hit > 0 and p50_off / p50_hit >= 5.0, \
+        f"hit p50 {p50_hit} not 5x under uncached p50 {p50_off}"
+    assert rstats["bytes"] <= budget, rstats
+    qps_uplift = on_qps / off_qps if off_qps else 0.0
+    assert qps_uplift > 1.0, (on_qps, off_qps)
+    on.shutdown()
+
+    # -- phase 3: concurrent writes, zero stale reads, caching ON ------
+    vg = versioned(session, create_graph(
+        session, "CREATE (:Seed {k:-1, v:-1})"))
+    wserver = QueryServer(session, graph=vg, config=ServerConfig(
+        workers=2, max_queue=4096,
+        result_cache=ResultCacheConfig(budget_bytes=budget)))
+    write_log, observations, log_lock = {}, [], _th.Lock()
+    n_writes = 24
+    read_hits = [0]
+
+    def writer():
+        for j in range(n_writes):
+            res = wserver.submit("CREATE (:Item {k:$k, v:$v})",
+                                 {"k": j, "v": j * 7}).result(timeout=60)
+            with log_lock:
+                write_log[res.metrics["snapshot_version"]] = (j, j * 7)
+
+    def reader(i):
+        for j in range(48):
+            h = wserver.submit("MATCH (n:Item) RETURN n.k AS k, "
+                               "n.v AS v")
+            rows = h.rows(timeout=60)
+            with log_lock:
+                observations.append(
+                    (h.info["snapshot_version"],
+                     frozenset((r["k"], r["v"]) for r in rows)))
+                if h.info.get("cache") == "hit":
+                    read_hits[0] += 1
+            assert (wserver.result_cache.bytes
+                    <= wserver.result_cache.config.budget_bytes)
+
+    wt = _th.Thread(target=writer)
+    readers = [_th.Thread(target=reader, args=(i,)) for i in range(4)]
+    for t in [wt] + readers:
+        t.start()
+    for t in [wt] + readers:
+        t.join()
+    stale = 0
+    for version, got in observations:
+        want = frozenset(kv for v, kv in write_log.items()
+                         if v <= version)
+        if got != want:
+            stale += 1
+    wstats = wserver.result_cache.stats()
+    wserver.shutdown()
+    assert stale == 0, f"{stale} stale reads under concurrent writes"
+    assert len(write_log) == n_writes, len(write_log)
+
+    _result.update({
+        "metric": f"result-cache hit ratio, zipf(1.1) over "
+                  f"{len(keys)} bindings, {clients} clients x "
+                  f"{per_client} reqs "
+                  f"({'tpu' if on_tpu else 'cpu-fallback'})",
+        "value": round(hit_ratio, 4),
+        "unit": "fraction",
+        "vs_baseline": round(p50_off / p50_hit, 1) if p50_hit else 0.0,
+        "requests_per_run": total,
+        "cache_hits": n_hits,
+        "p50_uncached_s": p50_off,
+        "p50_hit_s": p50_hit,
+        "hit_speedup_p50": round(p50_off / p50_hit, 1) if p50_hit else 0.0,
+        **{"off_" + k: v for k, v in _percentiles(off_lat).items()},
+        **{"on_" + k: v for k, v in _percentiles(on_lat).items()},
+        "telemetry_qps_off": off_qps,
+        "telemetry_qps_on": on_qps,
+        "telemetry_qps_uplift": round(qps_uplift, 2),
+        "budget_bytes": budget,
+        "rescache_bytes_final": rstats["bytes"],
+        "rescache_insertions": rstats["insertions"],
+        "rescache_evictions": rstats["evictions"],
+        "subplan_hits": rstats["subplan_hits"],
+        "write_phase_reads": len(observations),
+        "write_phase_read_hits": read_hits[0],
+        "write_phase_stale_reads": stale,
+        "write_phase_retired": wstats["retired"],
+        "digest_parity": True,
+    })
+    _emit()
 
 
 def run_serve_devices_config(on_tpu: bool, devices_n: int):
@@ -2100,6 +2316,8 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "ldbc":
         return run_ldbc_config(on_tpu)
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        if "--cache" in sys.argv:
+            return run_serve_cache_config(on_tpu)
         if "--devices" in sys.argv:
             i = sys.argv.index("--devices")
             devices_n = int(sys.argv[i + 1]) if i + 1 < len(sys.argv) else 2

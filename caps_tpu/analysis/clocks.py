@@ -37,6 +37,18 @@ BANNED = BANNED_TIME_READS
 def check(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     exempt = set(project.config.clock_exempt)
+    # vacuity guard (same contract as the error-taxonomy pass's expected
+    # module set): a pinned module that fell out of the walk means the
+    # check silently stopped covering code whose correctness depends on
+    # the sanctioned clock — finding, not skip
+    for rel in sorted(project.config.expected_clock_modules):
+        if project.source(rel) is None:
+            findings.append(Finding(
+                rel, 1, PASS,
+                f"expected module {rel!r} is missing from the analyzed "
+                f"tree — clock-discipline coverage went vacuous for it "
+                f"(renamed/moved? update AnalysisConfig"
+                f".expected_clock_modules)"))
     for src in project.sources:
         if src.in_dirs(exempt):
             continue

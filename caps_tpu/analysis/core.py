@@ -78,6 +78,14 @@ class AnalysisConfig:
         "caps_tpu/okapi", "caps_tpu/testing/faults.py")
     #: the one sanctioned time source (exempt from clock-discipline)
     clock_exempt: Tuple[str, ...] = ("caps_tpu/obs/clock.py",)
+    #: modules the clock-discipline pass MUST see — same vacuity guard
+    #: as ``expected_serve_modules``: a rename/move that dropped one of
+    #: these from the walk would silently stop checking code whose
+    #: correctness DEPENDS on the sanctioned clock (the result cache's
+    #: recency decay must tick on ``obs.clock`` or fake-clock tests and
+    #: production disagree)
+    expected_clock_modules: frozenset = frozenset({
+        "caps_tpu/relational/result_cache.py"})
     #: serving tier (error-taxonomy scope)
     serve_dir: str = "caps_tpu/serve"
     errors_rel: str = "caps_tpu/serve/errors.py"
@@ -98,7 +106,8 @@ class AnalysisConfig:
     #: exception is a mutation violation
     exception_markers: frozenset = frozenset({
         "caps_failed_op", "caps_device_index", "caps_transient",
-        "caps_device_fault", "caps_shard_member", "caps_wcoj_fault"})
+        "caps_device_fault", "caps_shard_member", "caps_wcoj_fault",
+        "caps_stale_cache"})
     #: sanctioned first segments of dotted metric names
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
@@ -106,7 +115,7 @@ class AnalysisConfig:
         "updates", "compaction", "telemetry", "slo", "opstats",
         "compile", "mem", "slowlog", "warmup", "bucket", "planstore",
         "cost", "stats", "replan", "shard", "paging", "wcoj",
-        "fleet", "router", "wire"})
+        "fleet", "router", "wire", "rescache"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)

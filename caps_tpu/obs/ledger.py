@@ -127,6 +127,8 @@ class MemoryLedger:
         self._lock = make_lock("ledger.MemoryLedger._lock")
         if registry is not None:
             registry.gauge("mem.plan_cache_bytes", fn=self.plan_cache_bytes)
+            registry.gauge("mem.result_cache_bytes",
+                           fn=self.result_cache_bytes)
             registry.gauge("mem.string_pool_bytes",
                            fn=self.string_pool_bytes)
             registry.gauge("mem.tracked_graph_bytes",
@@ -198,6 +200,16 @@ class MemoryLedger:
         except Exception:  # pragma: no cover — accounting must not fail
             return 0
 
+    def result_cache_bytes(self) -> int:
+        session = self._session()
+        cache = getattr(session, "result_cache", None)
+        if cache is None:
+            return 0
+        try:
+            return int(cache.bytes)
+        except Exception:  # pragma: no cover — accounting must not fail
+            return 0
+
     def string_pool_bytes(self) -> int:
         session = self._session()
         pool = getattr(getattr(session, "backend", None), "pool", None)
@@ -223,6 +235,7 @@ class MemoryLedger:
         devices = device_memory()
         return {
             "plan_cache_bytes": self.plan_cache_bytes(),
+            "result_cache_bytes": self.result_cache_bytes(),
             "string_pool_bytes": self.string_pool_bytes(),
             "graphs": graphs,
             "tracked_graph_bytes": sum(f["bytes"]

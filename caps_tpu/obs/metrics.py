@@ -308,4 +308,10 @@ def merge_snapshots(snaps: Sequence[Dict[str, Any]]) -> Dict[str, Number]:
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue
             out[k] = out.get(k, 0) + v
+    if "rescache.hit_ratio" in out:
+        # ratios don't sum: recompute the fleet-wide result-cache hit
+        # ratio from the summed hit/miss counters
+        h = out.get("rescache.hits", 0)
+        m = out.get("rescache.misses", 0)
+        out["rescache.hit_ratio"] = (h / (h + m)) if (h + m) else 0.0
     return out

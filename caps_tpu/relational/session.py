@@ -359,6 +359,12 @@ class RelationalCypherSession(CypherSession):
         self.plan_cache = PlanCache(self.config.plan_cache_size,
                                     enabled=self.config.use_plan_cache,
                                     registry=self.metrics_registry)
+        # Snapshot-keyed result & subplan cache (relational/
+        # result_cache.py): attached by the serving tier (ServerConfig
+        # .result_cache) — None means every read pays the device path.
+        # Must exist before the MemoryLedger below registers its
+        # mem.result_cache_bytes gauge over it.
+        self.result_cache = None
         # Memory ledger (obs/ledger.py): live mem.* gauges over the plan
         # cache, string pool, tracked graphs, and device allocator stats.
         self.memory_ledger = obs.MemoryLedger(
@@ -866,7 +872,15 @@ class RelationalCypherSession(CypherSession):
             if logical.returns_graph:
                 result_graph = self._evaluate_graph(root)
             else:
+                rcache = self.result_cache
+                if rcache is not None:
+                    # snapshot-keyed subplan reuse: seed memoized
+                    # scan→filter intermediates before pulling the root
+                    rcache.seed_subplans(root)
                 header, table = root.result
+                if rcache is not None:
+                    # capture BEFORE any reset_plan clears the memos
+                    rcache.store_subplans(root)
                 records = RelationalCypherRecords(
                     self, header, table, logical.result_fields,
                     graph=rel_planner.current_graph)
@@ -941,11 +955,18 @@ class RelationalCypherSession(CypherSession):
             context = plan.context
             context.rebind(params)
             reset_plan(plan.root)
+            rcache = self.result_cache
+            if rcache is not None:
+                # seed AFTER reset_plan (reset clears seeded memos)
+                rcache.seed_subplans(plan.root)
             t1 = clock.now()
             try:
                 with self.tracer.span("execute", kind="phase",
                                       plan_cache="hit"):
                     header, table = plan.root.result
+                    if rcache is not None:
+                        # capture before the finally's reset_plan
+                        rcache.store_subplans(plan.root)
                     records = RelationalCypherRecords(
                         self, header, table, plan.result_fields,
                         graph=plan.records_graph)

@@ -723,8 +723,8 @@ class VersionedGraph(RelationalCypherGraph):
         self._current = new_snap
         return new_snap
 
-    def install_state(self, state: DeltaState, version: int
-                      ) -> GraphSnapshot:
+    def install_state(self, state: DeltaState, version: int,
+                      on_install=None) -> GraphSnapshot:
         """Replication seam (serve/fleet.py): adopt an OWNER process's
         delta state at the owner's version — the peer half of snapshot
         shipping.  The delta tables rebuild through THIS session's
@@ -734,10 +734,22 @@ class VersionedGraph(RelationalCypherGraph):
         with, so readers keep snapshot isolation throughout.  Versions
         at or behind the current snapshot are ignored (idempotent
         re-ship, out-of-order delivery); the id allocator advances past
-        the shipped entities so a later owner promotion cannot collide."""
+        the shipped entities so a later owner promotion cannot collide.
+
+        ``on_install(new_snap)`` runs UNDER the commit lock, BEFORE the
+        reference swap publishes the snapshot (``current()`` is a
+        lock-free single read) — the rejoin fencing seam: version gauges
+        and superseded result-cache retirement happen-before any reader
+        can be admitted at the new version, so no read is ever served a
+        version the gauges don't yet report.  It also runs on the
+        idempotent early return (re-publishing current state is
+        harmless; skipping it would leave a rejoining peer's gauges
+        stale forever)."""
         with self._lock:
             snap = self._current
             if version <= snap.snapshot_version:
+                if on_install is not None:
+                    on_install(snap)
                 return snap
             pool = getattr(getattr(self._session, "backend", None),
                            "pool", None)
@@ -751,6 +763,9 @@ class VersionedGraph(RelationalCypherGraph):
                 raise
             new_snap = GraphSnapshot(self._session, snap.base, delta_graph,
                                      state, version, handle=self)
+            self._retire_superseded_results(version)
+            if on_install is not None:
+                on_install(new_snap)
             self._current = new_snap
             hi = max((r.id for r in state.nodes + state.rels), default=-1)
             self._next_id = max(self._next_id, hi + 1)
@@ -762,12 +777,25 @@ class VersionedGraph(RelationalCypherGraph):
         snapshot's token drop — an unrelated graph's cached plans (and
         other sessions' caches) are untouched.  Zero catalog fanout."""
         from caps_tpu.relational.plan_cache import graph_plan_token
+        self._retire_superseded_results(self._current.snapshot_version)
         tok = getattr(old_snap, "_plan_token", None)
         if tok is None:
             return  # never anchored a plan: nothing to evict
         cache = getattr(self._session, "plan_cache", None)
         if cache is not None:
             cache.evict_graph(tok)
+
+    def _retire_superseded_results(self, live_version: int) -> None:
+        """Result-cache retirement (relational/result_cache.py): drop
+        every cached result/intermediate of this lineage whose version
+        predates ``live_version`` — a dead version can never be read
+        again (readers resolve ``current()`` at admission), so its
+        entries are pure ballast.  New versions never *invalidate*
+        (version-keyed = new key space)."""
+        rcache = getattr(self._session, "result_cache", None)
+        if rcache is not None:
+            rcache.retire_superseded(
+                getattr(self, "_rescache_scope", None), live_version)
 
     # -- compaction ----------------------------------------------------
 

@@ -85,6 +85,11 @@ class BackendSpec:
     #: that serving-path parallelism, deterministically, even on a
     #: single-core CI host.  0.0 (default) = serve at real speed.
     service_dwell_s: float = 0.0
+    #: snapshot-keyed result-cache byte budget (relational/
+    #: result_cache.py); None = serve every read through the device.
+    #: The hash-ring's (graph, plan-family) affinity already routes a
+    #: hot family to one process, so its entries stay process-resident.
+    result_cache_budget: Optional[int] = None
     host: str = "127.0.0.1"
     #: 0 = ephemeral (the listener reports the bound port)
     port: int = 0
@@ -172,12 +177,18 @@ class FleetBackend:
             warmup = WarmupConfig(store_path=spec.plan_store_path,
                                   background=spec.warm_background,
                                   save_on_shutdown=True)
+        rescache = None
+        if spec.result_cache_budget is not None:
+            from caps_tpu.relational.result_cache import ResultCacheConfig
+            rescache = ResultCacheConfig(
+                budget_bytes=int(spec.result_cache_budget))
         self.server = QueryServer(
             session, graph=self.graph,
             config=ServerConfig(workers=spec.workers,
                                 max_queue=spec.max_queue,
                                 default_deadline_s=spec.default_deadline_s,
-                                warmup=warmup))
+                                warmup=warmup,
+                                result_cache=rescache))
         self._registry = session.metrics_registry
         self._shutting_down = threading.Event()
         self._conn_threads = []
@@ -349,10 +360,20 @@ class FleetBackend:
                              timeout_s=30.0) as owner:
             delta = owner.call("export_delta")
         state = delta_state_from_payload(delta["state"])
-        snap = self.graph.install_state(state, int(delta["version"]))
-        self._registry.counter("fleet.snapshots_installed").inc()
-        self._registry.gauge("fleet.snapshot_version").set(
-            float(snap.snapshot_version))
+
+        def _publish(new_snap) -> None:
+            # runs under the commit lock BEFORE the reference swap
+            # (relational/updates.py install_state): superseded result-
+            # cache entries retire and the version gauge updates
+            # happens-before any reader can be admitted at the new
+            # version — the rejoin fencing fix (no read is ever served
+            # a version the gauges don't yet report)
+            self._registry.counter("fleet.snapshots_installed").inc()
+            self._registry.gauge("fleet.snapshot_version").set(
+                float(new_snap.snapshot_version))
+
+        snap = self.graph.install_state(state, int(delta["version"]),
+                                        on_install=_publish)
         return {"version": snap.snapshot_version}
 
     def _op_stats(self, msg) -> Dict[str, Any]:

@@ -99,7 +99,7 @@ class Request:
 
     __slots__ = ("request_id", "query", "params", "graph", "priority",
                  "scope", "batch_key", "mode", "handle", "enqueued_t",
-                 "plan_key")
+                 "plan_key", "cache_key")
 
     def __init__(self, query: str, params: Mapping[str, Any], graph: Any,
                  priority: int, scope: CancelScope,
@@ -124,6 +124,10 @@ class Request:
         self.mode = mode
         self.handle = QueryHandle(self)
         self.enqueued_t = 0.0
+        #: ``(result-cache key, snapshot version)`` stamped at admission
+        #: when the read missed the result cache — completion offers the
+        #: materialized rows back under exactly this key (serve/server.py)
+        self.cache_key: Optional[Tuple] = None
 
     def drop_cancelled(self) -> bool:
         """Complete a dequeued-but-cancelled request without executing.

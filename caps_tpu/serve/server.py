@@ -81,6 +81,9 @@ from typing import Any, Dict, List, Mapping, Optional
 
 from caps_tpu.obs import clock
 from caps_tpu.obs.lockgraph import make_lock
+from caps_tpu.relational.result_cache import (CachedRows, ResultCacheConfig,
+                                              graph_version,
+                                              result_cache_key)
 from caps_tpu.obs.log import EventLog, SlowQueryLog
 from caps_tpu.obs.telemetry import ServingTelemetry, SLOConfig
 from caps_tpu.serve import batcher as _batcher
@@ -248,6 +251,11 @@ class ServerConfig:
     #: box, dumped on breaker-trip / quarantine / compaction-failure
     #: and via ``dump_flight_recorder()``)
     flight_recorder_size: int = 256
+    #: snapshot-keyed result & subplan cache (relational/result_cache.py):
+    #: hot repeated reads return at ADMISSION — no worker slot, no device
+    #: dwell, no batch window (flight records stamp outcome="cache_hit").
+    #: None = every read pays the device path.
+    result_cache: Optional["ResultCacheConfig"] = None
 
 
 class QueryServer:
@@ -309,6 +317,18 @@ class QueryServer:
         ledger = getattr(session, "memory_ledger", None)
         if ledger is not None:
             ledger.track("default", self._default_graph, owner=self)
+        #: snapshot-keyed result & subplan cache (relational/
+        #: result_cache.py): consulted at admission, fed at completion.
+        #: Attached to the session so the execution paths seed/store
+        #: subplan intermediates and the memory ledger's
+        #: mem.result_cache_bytes gauge sees it.
+        self.result_cache = None
+        if self.config.result_cache is not None \
+                and self.config.result_cache.enabled:
+            from caps_tpu.relational.result_cache import ResultCache
+            self.result_cache = ResultCache(self.config.result_cache,
+                                            registry=registry)
+            session.result_cache = self.result_cache
         #: shard-group capacity members (serve/shards.py): one group of
         #: ``config.shards`` member devices fronting the partitioned
         #: ``shard_graph`` (default: the server's default graph).  Built
@@ -501,6 +521,13 @@ class QueryServer:
         ledger = getattr(self.session, "memory_ledger", None)
         if ledger is not None:
             ledger.untrack_if("default", self._default_graph, owner=self)
+        if self.result_cache is not None:
+            # detach only OUR cache — a newer server may have attached
+            # its own meanwhile (same discipline as untrack_if above)
+            if getattr(self.session, "result_cache", None) \
+                    is self.result_cache:
+                self.session.result_cache = None
+            self.result_cache.clear()
 
     def __enter__(self) -> "QueryServer":
         return self.start()
@@ -562,6 +589,21 @@ class QueryServer:
                       plan_key=plan_key)
         if getattr(graph, "snapshot_version", None) is not None:
             req.handle.info["snapshot_version"] = graph.snapshot_version
+        if self.result_cache is not None and mode is None \
+                and plan_key is not None:
+            # result-cache fast path, BEFORE the queue: a hit returns
+            # without consuming a worker slot, device dwell, or batch
+            # window.  Writes/EXPLAIN/PROFILE (mode set) and
+            # unanchorable graphs (plan_key None) never consult it.
+            ck = result_cache_key(graph, query, params)
+            if ck is not None:
+                version = graph_version(graph)
+                rows = self.result_cache.lookup(ck, version)
+                if rows is not None:
+                    self._serve_cache_hit(req, rows)
+                    return req.handle
+                # miss: completion offers the rows back under this key
+                req.cache_key = (ck, version)
         self.admission.offer(req)  # may raise ServerClosed / Overloaded
         return req.handle
 
@@ -1267,6 +1309,11 @@ class QueryServer:
         (the ladder and a breaker trip must not double-count)."""
         req.handle.info["quarantined"] = True
         self._quarantines.inc()
+        if self.result_cache is not None and req.plan_key is not None:
+            # a quarantined family may have produced poisoned rows — its
+            # cached results (and every shared memoized intermediate)
+            # must go with the plan (relational/result_cache.py)
+            self.result_cache.evict_family(req.plan_key[1])
         if isinstance(replica, ShardGroup):
             # group-routed: evict on the session that actually served
             # this family (owning member or the cross-shard session)
@@ -1312,11 +1359,85 @@ class QueryServer:
             req.handle._complete(exception=ex)
             return
         self._note_ledger(req, outcome)
+        self._store_result(req, rows)
         req.handle.info["latency_s"] = req.scope.elapsed()
         self._latency.observe(req.handle.info["latency_s"])
         self._completed.inc()
         self._flight(req, None, outcome)
         req.handle._complete(result=outcome, rows=rows)
+
+    def _serve_cache_hit(self, req: Request, rows: list) -> None:
+        """Complete a request AT ADMISSION from the result cache: no
+        worker slot, no device dwell, no batch window.  The flight
+        record stamps ``outcome="cache_hit"`` / ``phase="cache"`` so the
+        black box distinguishes memory-served reads from device-served
+        ones, and windowed telemetry counts the hit as an ok result
+        (hits ARE served traffic — qps/availability must see them)."""
+        info = req.handle.info
+        try:
+            # a zero/negative deadline budget expires even here
+            req.scope.raise_if_done("cache")
+        except CancellationError as ex:
+            self._count_failure(ex)
+            self._flight(req, ex)
+            req.handle._complete(exception=ex)
+            return
+        info["cache"] = "hit"
+        info["queue_wait_s"] = 0.0
+        info["ledger"] = {"bytes_in": 0, "bytes_out": 0,
+                          "compile_s": 0.0, "peak_rows": len(rows)}
+        latency_s = req.scope.elapsed()
+        info["latency_s"] = latency_s
+        self._latency.observe(latency_s)
+        self._completed.inc()
+        family = self._family_label(req)
+        self.telemetry.note_result(family, latency_s, "ok")
+        rec: Dict[str, Any] = {
+            "request_id": req.request_id,
+            "family": family,
+            "priority": req.priority,
+            "device": None,
+            "batch_size": None,
+            "queue_wait_s": 0.0,
+            "latency_s": round(latency_s, 6),
+            "phase": "cache",
+            "outcome": "cache_hit",
+            "ledger": info["ledger"],
+        }
+        if info.get("snapshot_version") is not None:
+            rec["snapshot_version"] = info["snapshot_version"]
+        self.telemetry.recorder.record(rec)
+        req.handle._complete(result=CachedRows(rows), rows=rows)
+
+    def _observed_service_s(self, req: Request) -> float:
+        """Observed per-execution seconds for this request's plan family
+        (session.op_stats) — the admission benefit estimate.  Falls back
+        to the request's own measured latency when the family has no
+        folded statistics yet."""
+        try:
+            stats = self.session.op_stats.stats(self._family_label(req))
+            total = execs = 0.0
+            for entry in stats.values():
+                total += float(entry.get("wall_s_total") or 0.0)
+                execs = max(execs, float(entry.get("executions") or 0))
+            if execs > 0 and total > 0:
+                return total / execs
+        except Exception:  # pragma: no cover — estimation must not fail
+            pass
+        return max(0.0, req.scope.elapsed())
+
+    def _store_result(self, req: Request, rows: Optional[list]) -> None:
+        """Completion-side feed: offer the materialized rows back to the
+        result cache under the key stamped at admission (cost-aware —
+        the cache decides)."""
+        if self.result_cache is None or req.cache_key is None \
+                or rows is None:
+            return
+        key, version = req.cache_key
+        ledger = req.handle.info.get("ledger") or {}
+        nbytes = int(ledger.get("bytes_out") or 0)
+        self.result_cache.offer(key, version, rows, nbytes=nbytes,
+                                service_s=self._observed_service_s(req))
 
     def _note_ledger(self, req: Request, result: Any) -> None:
         """The per-request resource ledger (ISSUE 10): bytes pulled

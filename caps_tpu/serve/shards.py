@@ -910,6 +910,13 @@ class ShardGroup:
         # group's one dispatch stream lock
         quarantine_plan_state(session, graph, query, params,
                               exec_lock=self.lock)
+        # member sessions carry their own result caches when serving is
+        # cache-enabled: a poisoned family's materialized rows (and the
+        # shared memoized intermediates) go with the plan
+        rcache = getattr(session, "result_cache", None)
+        if rcache is not None:
+            from caps_tpu.frontend.parser import normalize_query
+            rcache.evict_family(normalize_query(query))
 
     # -- ladder bookkeeping (the server's outcome feed) ----------------
 

@@ -39,6 +39,10 @@ This module provides them:
 * :func:`flaky_compaction` — fail a deterministic fraction of
   compaction folds, scoped to the compaction thread only (serving
   and writes never see it);
+* :func:`stale_cache` — forge a wrong-version result-cache entry
+  (relational/result_cache.py) at the load seam, proving the
+  snapshot-version check rejects it (a served forgery raises a fresh
+  ``caps_stale_cache``-marked error instead of silent wrong rows);
 * :func:`corrupt_shard` — silent data damage on one shard (digest /
   parity detection tests);
 * :func:`stale_statistics` — distort one graph's ingest-time
@@ -337,6 +341,81 @@ def slow_network(delay_s: float, n_times: Optional[int] = None,
     finally:
         with OPERATOR_PATCH._lock:
             wire.send_frame = orig
+
+
+class _ForgedCacheEntry:
+    """A wrong-version result-cache entry (see :func:`stale_cache`):
+    the version reads one AHEAD of the real entry's, and touching
+    ``rows`` — which only a BROKEN version check would do — raises a
+    fresh marked exception.  A correct lookup rejects the forgery on
+    version alone and never trips the trap."""
+
+    def __init__(self, real, exc_spec: ExcSpec):
+        self._real = real
+        self._exc_spec = exc_spec
+        self.key = real.key
+        self.version = real.version + 1
+        self.nbytes = real.nbytes
+        self.service_s = real.service_s
+        self.hits = real.hits
+        self.stored_t = real.stored_t
+        self.last_t = real.last_t
+
+    @property
+    def rows(self):
+        err = _fresh_exception(self._exc_spec)
+        if getattr(err, "caps_stale_cache", None) is None:
+            # first-writer-wins marker discipline (serve/failure.py):
+            # never overwrite a classification already stamped
+            try:
+                err.caps_stale_cache = True
+            except Exception:  # pragma: no cover — slotted exception
+                pass
+        raise err
+
+
+@contextlib.contextmanager
+def stale_cache(n_times: Optional[int] = 1, every_n: int = 1,
+                exc: ExcSpec = None):
+    """While active, eligible result-cache loads
+    (:meth:`caps_tpu.relational.result_cache.ResultCache._load`) return
+    a FORGED entry whose snapshot version is wrong (one ahead of the
+    real entry's) — the deterministic probe that the cache's version
+    check actually rejects stale entries.
+
+    A correct ``lookup`` sees the version mismatch, counts a
+    ``rescache.stale_rejects``, drops the (real) entry, and reports a
+    miss — the caller re-executes and repopulates; the forgery's
+    ``rows`` are NEVER touched.  A broken check that served the forgery
+    would raise a fresh ``AssertionError`` per injection (template
+    overridable via ``exc``), marked ``caps_stale_cache`` first-writer-
+    wins — so the failure is attributable even after the serving tier's
+    classify/retry ladder wraps it.  Loads that find no entry inject
+    nothing (there is nothing to forge).  Installed/restored under the
+    shared fault lock; injections count ``faults.injected.stale_cache``.
+    Yields the budget (``.injected``)."""
+    from caps_tpu.relational.result_cache import ResultCache
+    if exc is None:
+        exc = lambda: AssertionError(  # noqa: E731 — fresh per injection
+            "injected: stale result-cache entry was served")
+    budget = _Budget(n_times, every_n)
+
+    with OPERATOR_PATCH._lock:
+        orig = ResultCache._load
+
+        def forging(self, key):
+            entry = orig(self, key)
+            if entry is not None and budget.take():
+                _count_injection("stale_cache")
+                return _ForgedCacheEntry(entry, exc)
+            return entry
+
+        ResultCache._load = forging
+    try:
+        yield budget
+    finally:
+        with OPERATOR_PATCH._lock:
+            ResultCache._load = orig
 
 
 @contextlib.contextmanager

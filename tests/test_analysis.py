@@ -512,6 +512,12 @@ class Server:
 
 # -- clock-discipline --------------------------------------------------------
 
+#: synthetic trees don't carry the real repo's pinned clock modules —
+#: the vacuity-guard test covers that contract explicitly
+_CLOCK_CONFIG = dataclasses.replace(
+    AnalysisConfig(), expected_clock_modules=frozenset())
+
+
 def test_clock_from_import_hole_fires(tmp_path):
     src = """\
 from time import perf_counter
@@ -520,7 +526,7 @@ from time import perf_counter
 def t():
     return perf_counter()
 """
-    p = _project(tmp_path, {"caps_tpu/serve/t.py": src})
+    p = _project(tmp_path, {"caps_tpu/serve/t.py": src}, _CLOCK_CONFIG)
     found = _findings(p, "clock-discipline")
     # the import line itself is the finding — the exact form the old
     # regex (matching `time.perf_counter(`) could never see
@@ -534,15 +540,32 @@ import time as _t
 
 now = _t.perf_counter
 """
-    p = _project(tmp_path, {"caps_tpu/relational/t.py": src})
+    p = _project(tmp_path, {"caps_tpu/relational/t.py": src}, _CLOCK_CONFIG)
     found = _findings(p, "clock-discipline")
     assert _lines(found) == {("caps_tpu/relational/t.py", 3)}
 
 
 def test_clock_exempts_clock_module(tmp_path):
     src = "import time as _time\nnow = _time.perf_counter\n"
-    p = _project(tmp_path, {"caps_tpu/obs/clock.py": src})
+    p = _project(tmp_path, {"caps_tpu/obs/clock.py": src}, _CLOCK_CONFIG)
     assert _findings(p, "clock-discipline") == []
+
+
+def test_clock_expected_module_vacuity_guard(tmp_path):
+    """A pinned clock module missing from the walk is a FINDING — the
+    pass must not silently stop covering code whose correctness depends
+    on the sanctioned clock (the result cache's recency decay)."""
+    p = _project(tmp_path, {"caps_tpu/serve/t.py": "x = 1\n"})
+    found = _findings(p, "clock-discipline")
+    assert _lines(found) == {
+        ("caps_tpu/relational/result_cache.py", 1)}
+    assert "vacuous" in found[0].message
+    # present → clean (and the module itself is checked as usual)
+    p2 = _project(tmp_path / "ok", {
+        "caps_tpu/serve/t.py": "x = 1\n",
+        "caps_tpu/relational/result_cache.py":
+            "from caps_tpu.obs import clock\nnow_t = clock.now\n"})
+    assert _findings(p2, "clock-discipline") == []
 
 
 # -- metric-names ------------------------------------------------------------
@@ -588,11 +611,12 @@ def wire(reg):
 def test_inline_suppression(tmp_path):
     src = ("from time import perf_counter  "
            "# capslint: disable=clock-discipline\n")
-    p = _project(tmp_path, {"caps_tpu/serve/t.py": src})
+    p = _project(tmp_path, {"caps_tpu/serve/t.py": src}, _CLOCK_CONFIG)
     assert _findings(p, "clock-discipline") == []
     # disable=all works too, and an unrelated pass name does NOT suppress
     src2 = "from time import perf_counter  # capslint: disable=lock-order\n"
-    p2 = _project(tmp_path / "b", {"caps_tpu/serve/t.py": src2})
+    p2 = _project(tmp_path / "b", {"caps_tpu/serve/t.py": src2},
+                  _CLOCK_CONFIG)
     assert len(_findings(p2, "clock-discipline")) == 1
 
 
@@ -606,6 +630,11 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
     (tmp_path / "caps_tpu").mkdir()
     (tmp_path / "caps_tpu" / "bad.py").write_text(
         "from time import perf_counter\n")
+    # satisfy the default config's pinned-module vacuity guard so the
+    # single finding below is exactly the naked import
+    (tmp_path / "caps_tpu" / "relational").mkdir()
+    (tmp_path / "caps_tpu" / "relational" / "result_cache.py").write_text(
+        "from caps_tpu.obs import clock\n")
     rc = capslint_main(["--root", str(tmp_path), "--json",
                         "--only", "clock-discipline"])
     out = json.loads(capsys.readouterr().out)
@@ -728,6 +757,9 @@ def test_run_shim_separates_parse_failures(tmp_path, capsys):
     from caps_tpu.analysis import run_shim
     (tmp_path / "caps_tpu").mkdir()
     (tmp_path / "caps_tpu" / "broken.py").write_text("def oops(:\n")
+    (tmp_path / "caps_tpu" / "relational").mkdir()
+    (tmp_path / "caps_tpu" / "relational" / "result_cache.py").write_text(
+        "from caps_tpu.obs import clock\n")
     rc = run_shim("clock-discipline", header="naked timers found:",
                   clean_message="clean", root=str(tmp_path))
     out = capsys.readouterr().out
