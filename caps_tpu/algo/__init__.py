@@ -1,0 +1,40 @@
+"""Graph-algorithm procedures: the ``CALL algo.*`` analytics tier.
+
+A registry of iterative graph algorithms (PageRank, WCC, BFS, SSSP,
+degree) invocable from openCypher as ``CALL algo.<name>(...) YIELD
+...`` and composable with the rest of the query.  The package splits
+into:
+
+* :mod:`caps_tpu.algo.registry` — signatures, defaults, typed
+  resolution errors (what the semantic pass consults);
+* :mod:`caps_tpu.algo.kernels` — host NumPy kernels: the differential
+  oracle and the degraded fallback;
+* :mod:`caps_tpu.algo.fixpoint` — fixed-shape jitted ``lax.while_loop``
+  device programs over shape-lattice bucketed capacities;
+* :mod:`caps_tpu.algo.op` — the relational operator dispatching
+  device-fixpoint vs host with ledger-charged compiles and counted
+  fallbacks.
+"""
+from caps_tpu.algo.registry import (  # noqa: F401
+    ProcedureArgumentError,
+    ProcedureError,
+    ProcedureSignature,
+    ProcedureYieldError,
+    UnknownProcedureError,
+    lookup,
+    maybe_lookup,
+    procedure_names,
+    registered_signatures,
+)
+
+__all__ = [
+    "ProcedureArgumentError",
+    "ProcedureError",
+    "ProcedureSignature",
+    "ProcedureYieldError",
+    "UnknownProcedureError",
+    "lookup",
+    "maybe_lookup",
+    "procedure_names",
+    "registered_signatures",
+]

@@ -107,7 +107,7 @@ class AnalysisConfig:
     exception_markers: frozenset = frozenset({
         "caps_failed_op", "caps_device_index", "caps_transient",
         "caps_device_fault", "caps_shard_member", "caps_wcoj_fault",
-        "caps_stale_cache"})
+        "caps_algo_fault", "caps_stale_cache"})
     #: sanctioned first segments of dotted metric names
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
@@ -115,7 +115,7 @@ class AnalysisConfig:
         "updates", "compaction", "telemetry", "slo", "opstats",
         "compile", "mem", "slowlog", "warmup", "bucket", "planstore",
         "cost", "stats", "replan", "shard", "paging", "wcoj",
-        "fleet", "router", "wire", "rescache"})
+        "fleet", "router", "wire", "rescache", "algo"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)
@@ -127,7 +127,7 @@ class AnalysisConfig:
     #: reads, RNG, or module-state mutation there breaks replayability)
     purity_method_roots: Tuple[str, ...] = ("_compute",)
     purity_method_dirs: Tuple[str, ...] = (
-        "caps_tpu/relational", "caps_tpu/backends")
+        "caps_tpu/relational", "caps_tpu/backends", "caps_tpu/algo")
     #: the generated metrics registry document (drift-checked in CI)
     metrics_doc_rel: str = "docs/metrics.md"
 

@@ -140,6 +140,11 @@ class DeviceBackend:
         # shapes whose first launch already charged the compile ledger's
         # ``wcoj`` kind — warmed shapes (and fused replays) charge zero.
         self.wcoj_compiled_shapes: set = set()
+        # Graph-algorithm fixpoint programs (caps_tpu/algo/): jitted
+        # per-(procedure, node capacity, edge capacity) closures; a miss
+        # builds + first-dispatches one program and charges the compile
+        # ledger's ``algo`` kind.
+        self.algo_fns: Dict[tuple, object] = {}
         self.mesh = None
         self.axis = config.mesh_axis
         # degenerate leading axes collapse to a 1-D mesh so (1, 8) keeps

@@ -142,6 +142,20 @@ class DeleteClause(Clause):
     detach: bool = False
 
 
+@dataclasses.dataclass(frozen=True)
+class CallClause(Clause):
+    """``CALL proc.name(args) [YIELD col [AS alias], ...]``.
+
+    ``yields`` holds ``(column, alias-or-None)`` pairs as written; an
+    empty tuple means no YIELD was given and the semantic pass expands
+    it to every registered output column under its default name.
+    ``where`` is the optional predicate right after the YIELD items."""
+    procedure: str
+    args: Tuple[Expr, ...] = ()
+    yields: Tuple[Tuple[str, Optional[str]], ...] = ()
+    where: Optional[Expr] = None
+
+
 # -- multiple-graph clauses (Cypher 10 extensions) --------------------------
 
 @dataclasses.dataclass(frozen=True)

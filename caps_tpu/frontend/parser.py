@@ -187,7 +187,39 @@ class CypherParser:
             return ast.FromGraphClause(self._parse_qualified_name())
         if self.accept_kw("CONSTRUCT"):
             return self._parse_construct()
+        if self.accept_kw("CALL"):
+            return self._parse_call()
         raise self.error(f"unexpected token {self.peek().text!r} at clause start")
+
+    def _parse_call(self) -> ast.CallClause:
+        """``CALL`` consumed: dotted procedure name, optional argument
+        list, optional ``YIELD`` items with ``AS`` aliases.  Name
+        resolution (and arity/type checking) is the semantic pass's job
+        — the grammar accepts any dotted name."""
+        parts = [self.ident_like("procedure name")]
+        while self.accept_sym("."):
+            parts.append(self.ident_like("procedure name"))
+        name = ".".join(parts)
+        args: List[E.Expr] = []
+        if self.accept_sym("("):
+            if not self.at_sym(")"):
+                args.append(self.parse_expr())
+                while self.accept_sym(","):
+                    args.append(self.parse_expr())
+            self.expect_sym(")")
+        yields: List[Tuple[str, Optional[str]]] = []
+        where: Optional[E.Expr] = None
+        if self.accept_kw("YIELD"):
+            while True:
+                yname = self.ident_like("yield column")
+                alias = self.ident_like("alias") if self.accept_kw("AS") \
+                    else None
+                yields.append((yname, alias))
+                if not self.accept_sym(","):
+                    break
+            if self.accept_kw("WHERE"):
+                where = self.parse_expr()
+        return ast.CallClause(name, tuple(args), tuple(yields), where)
 
     def _parse_match(self, optional: bool) -> ast.MatchClause:
         pattern = self.parse_pattern()

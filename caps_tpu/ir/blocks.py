@@ -71,6 +71,16 @@ class UnwindBlock(Block):
 
 
 @dataclasses.dataclass(frozen=True)
+class CallBlock(Block):
+    """``CALL proc(...) YIELD ...`` — a registered graph-algorithm
+    procedure; ``yields`` holds ``(procedure column, output name)``
+    pairs with aliases already resolved by the builder."""
+    procedure: str
+    args: Tuple[Expr, ...] = ()
+    yields: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class FromGraphBlock(Block):
     qgn: QualifiedGraphName
 

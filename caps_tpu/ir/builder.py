@@ -23,10 +23,10 @@ from caps_tpu.frontend import ast
 from caps_tpu.frontend.semantic import CypherSemanticError, check_statement
 from caps_tpu.ir import exprs as E
 from caps_tpu.ir.blocks import (
-    AggregationBlock, Block, ConstructBlock, CreateGraphStatement, CypherQuery,
-    CypherStatement, DropGraphStatement, FilterBlock, FromGraphBlock,
-    MatchBlock, OrderAndSliceBlock, ProjectBlock, ResultBlock, ReturnGraphBlock,
-    SelectBlock, UnionOfQueries, UnwindBlock,
+    AggregationBlock, Block, CallBlock, ConstructBlock, CreateGraphStatement,
+    CypherQuery, CypherStatement, DropGraphStatement, FilterBlock,
+    FromGraphBlock, MatchBlock, OrderAndSliceBlock, ProjectBlock, ResultBlock,
+    ReturnGraphBlock, SelectBlock, UnionOfQueries, UnwindBlock,
 )
 from caps_tpu.ir.pattern import Connection, Direction, IRField, Pattern
 from caps_tpu.ir.typer import SchemaTyper
@@ -85,6 +85,12 @@ class IRBuilder:
         b = _SingleQueryBuilder(self)
         for clause in q.clauses:
             b.add_clause(clause)
+        if q.clauses and isinstance(q.clauses[-1], ast.CallClause):
+            # standalone trailing CALL: its YIELD columns are the result
+            # (a WHERE after YIELD appends a FilterBlock — look past it)
+            call = next(blk for blk in reversed(b.blocks)
+                        if isinstance(blk, CallBlock))
+            b.blocks.append(ResultBlock(tuple(o for _, o in call.yields)))
         return CypherQuery(tuple(b.blocks))
 
 
@@ -136,6 +142,8 @@ class _SingleQueryBuilder:
             self._add_construct(clause)
         elif isinstance(clause, ast.ReturnGraphClause):
             self.blocks.append(ReturnGraphBlock())
+        elif isinstance(clause, ast.CallClause):
+            self._add_call(clause)
         elif isinstance(clause, ast.CreateClause):
             raise IRBuildError(
                 "CREATE as a query clause is not supported; use the graph "
@@ -621,6 +629,23 @@ class _SingleQueryBuilder:
     @staticmethod
     def _uses_only(expr: E.Expr, names: List[str]) -> bool:
         return all(v.name in names for v in E.vars_in(expr))
+
+    # -- CALL ---------------------------------------------------------------
+
+    def _add_call(self, clause: ast.CallClause) -> None:
+        """Resolve the procedure against the registry (the semantic pass
+        already validated it) and declare the YIELD outputs into scope
+        with the registered column types."""
+        from caps_tpu.algo import registry
+        sig = registry.lookup(clause.procedure)
+        yields = clause.yields or tuple((n, None) for n in sig.yield_names)
+        resolved = tuple((y, a or y) for y, a in yields)
+        self.blocks.append(CallBlock(clause.procedure, tuple(clause.args),
+                                     resolved))
+        for yname, out in resolved:
+            self.env[out] = sig.yield_type(yname)
+        if clause.where is not None:
+            self.blocks.append(FilterBlock(self._resolve(clause.where)))
 
     # -- multiple graphs ----------------------------------------------------
 

@@ -226,6 +226,18 @@ class TabularUnionAll(LogicalOperator):
 
 
 @dataclasses.dataclass(frozen=True)
+class ProcedureCall(LogicalOperator):
+    """``CALL algo.*`` — run one registered graph-algorithm procedure
+    over the working graph's snapshot; ``yields`` holds ``(procedure
+    column, output name)`` pairs and ``fields`` the resulting columns."""
+    parent: LogicalOperator
+    procedure: str
+    args: Tuple[Expr, ...]
+    yields: Tuple[Tuple[str, str], ...]
+    fields: Fields = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class FromGraph(LogicalOperator):
     """Switch the working graph for operators above this one."""
     parent: LogicalOperator

@@ -196,9 +196,28 @@ class _QueryPlanner:
                                     block.news, block.sets, fields=())
         if isinstance(block, B.ReturnGraphBlock):
             return L.ReturnGraph(op, fields=())
+        if isinstance(block, B.CallBlock):
+            return self._plan_call(op, block)
         if isinstance(block, B.ResultBlock):
             return self._select(op, block.fields)
         raise LogicalPlanningError(f"cannot plan block {type(block).__name__}")
+
+    def _plan_call(self, op: L.LogicalOperator, block: B.CallBlock
+                   ) -> L.LogicalOperator:
+        """CALL composes like a scan of a fresh component: chained onto
+        an empty-row upstream, cross-producted onto populated rows (one
+        output row per (input row, yielded row) pair)."""
+        from caps_tpu.algo import registry
+        sig = registry.lookup(block.procedure)
+        new_fields = tuple((out, sig.yield_type(y))
+                           for y, out in block.yields)
+        if not op.fields:
+            return L.ProcedureCall(op, block.procedure, block.args,
+                                   block.yields, fields=new_fields)
+        call = L.ProcedureCall(L.Start(self.current_graph, fields=()),
+                               block.procedure, block.args, block.yields,
+                               fields=new_fields)
+        return L.CartesianProduct(op, call, fields=op.fields + call.fields)
 
     def _select(self, op: L.LogicalOperator, names: Tuple[str, ...]) -> L.LogicalOperator:
         env = op.env

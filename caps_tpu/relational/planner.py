@@ -410,7 +410,25 @@ class RelationalPlanner:
             return plan_construct(self, op)
         if isinstance(op, L.EmptyRecords):
             return R.StartOp(ctx)
+        if isinstance(op, L.ProcedureCall):
+            return self._plan_procedure(op)
         raise RelationalPlanningError(f"cannot plan {type(op).__name__}")
+
+    def _plan_procedure(self, op: L.ProcedureCall) -> R.RelationalOperator:
+        from caps_tpu.algo import registry
+        from caps_tpu.algo.op import AlgoProcedureOp
+        parent = self.plan_op(op.parent)
+        sig = registry.lookup(op.procedure)
+        prefer_host = False
+        if self.cost_model is not None:
+            try:
+                prefer_host = not self.cost_model.algo_pushdown_wins(
+                    sig.name, sig.est_iterations)
+            except Exception:  # pragma: no cover — pricing must not fail
+                prefer_host = False
+        return AlgoProcedureOp(self.context, parent, self.current_graph,
+                               sig, op.args, op.yields,
+                               prefer_host=prefer_host)
 
     def _pushdown_wins(self, pushed) -> bool:
         """Price the matched count chain both ways (relational/cost.py

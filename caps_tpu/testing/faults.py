@@ -536,6 +536,47 @@ def failing_wcoj(exc: ExcSpec = None, n_times: Optional[int] = 1):
             MultiwayJoinOp._compute_wcoj = orig
 
 
+@contextlib.contextmanager
+def failing_algo(exc: ExcSpec = None, n_times: Optional[int] = 1):
+    """Fail the graph-algorithm procedure's DEVICE fixpoint path
+    (algo/op.py ``AlgoProcedureOp._compute_device``) — the analytics
+    tier's degraded-mode probe: the operator must catch the fault, count
+    ``algo.fallbacks``, and serve the SAME answer through the NumPy
+    host kernel (``algo/kernels.py``), so fallback-parity tests are
+    deterministic instead of hoping for a real device fault.
+
+    A FRESH exception per injection (``exc`` semantics as
+    :func:`failing_operator`; default a realistic device OOM), stamped
+    ``caps_algo_fault`` first-writer-wins at construction so assertions
+    can attribute what they caught.  ``n_times=1`` fails exactly the
+    next device dispatch then heals (the following execution must take
+    the device path again); ``n_times=None`` is permanent (every CALL
+    serves from the host twin).  Installed/restored on the shared fault
+    lock like every other patch point; injections count
+    ``faults.injected.algo``.  Yields the budget (``.injected``)."""
+    from caps_tpu.algo.op import AlgoProcedureOp
+    budget = _Budget(n_times)
+
+    with OPERATOR_PATCH._lock:
+        orig = AlgoProcedureOp._compute_device
+
+        def faulted(op_self, data, bound):
+            if budget.take():
+                _count_injection("algo")
+                e = _fresh_exception(exc)
+                if getattr(e, "caps_algo_fault", None) is None:
+                    e.caps_algo_fault = True
+                raise e
+            return orig(op_self, data, bound)
+
+        AlgoProcedureOp._compute_device = faulted
+    try:
+        yield budget
+    finally:
+        with OPERATOR_PATCH._lock:
+            AlgoProcedureOp._compute_device = orig
+
+
 def _make_device_down(device_index: int) -> BaseException:
     """A fresh ``UNAVAILABLE`` in the shape a dead/preempted device
     raises it (serve/failure.py classifies the status word TRANSIENT —
