@@ -2447,6 +2447,215 @@ def run_fleet_config(on_tpu: bool, procs: int):
     _emit()
 
 
+def run_durability_config(on_tpu: bool):
+    """``bench.py durability`` — durable writes under owner loss
+    (ISSUE 19).
+
+    Spawns 3 REAL backend interpreters sharing one durable store
+    (per-backend WAL + epoch-fenced lease), runs a write soak of
+    idempotent per-id SETs with concurrent readers, SIGKILLs the write
+    owner mid-soak, and measures:
+
+      * recovery seconds — SIGKILL to the next acknowledged write (the
+        router elects the peer with the longest replayed log, which
+        claims the lease after the dead owner's TTL lapses);
+      * zero acked-write loss — the surviving fleet's full-table digest
+        equals a serial in-process oracle that applied exactly the
+        acknowledged writes in order;
+      * read availability 1.0 — every reader request through the soak
+        (including the failover window) succeeds via ring retries;
+      * the split-brain fence — the dead owner restarted as a zombie
+        has its write frames refused with StaleEpoch (stale epoch AND
+        no epoch), applying nothing;
+      * sharded commits — CREATE/SET/DELETE through an in-process
+        shard group is digest-equal to an unsharded versioned session.
+    """
+    import tempfile
+
+    import caps_tpu
+    from caps_tpu.obs.metrics import MetricsRegistry
+    from caps_tpu.relational.session import result_digest
+    from caps_tpu.relational.updates import VersionedGraph
+    from caps_tpu.serve.errors import ServeError, StaleEpoch
+    from caps_tpu.serve.fleet import (BackendSpec, rows_digest,
+                                      spawn_backend)
+    from caps_tpu.serve.router import FleetRouter, RouterConfig
+    from caps_tpu.serve.shards import ShardGroup, ShardGroupConfig
+    from caps_tpu.serve.wire import WireClient
+    from caps_tpu.testing.factory import create_graph
+
+    n_ids = 8
+    create = "CREATE " + ", ".join(
+        f"(p{i}:Person {{id: {i}, age: {20 + i}}})"
+        for i in range(1, n_ids + 1))
+    gspec = {"kind": "script", "create": create}
+    q_write = "MATCH (p:Person {id: $id}) SET p.v = $v"
+    q_read = ("MATCH (p:Person) WHERE p.age > $min "
+              "RETURN p.name AS n ORDER BY n")
+    q_all = ("MATCH (p:Person) RETURN p.id AS id, p.age AS age, "
+             "p.v AS v ORDER BY id")
+
+    store = tempfile.mkdtemp(prefix="caps-durability-")
+    ttl_s = 1.0
+
+    def durable_spec(name):
+        return BackendSpec(name=name, backend="local", graph=gspec,
+                           versioned=True, workers=2, max_queue=512,
+                           durable_dir=store, wal_fsync="always",
+                           lease_ttl_s=ttl_s)
+
+    children = {}
+    backends = {}
+    router = None
+    try:
+        for name in ("d0", "d1", "d2"):
+            proc, port = spawn_backend(durable_spec(name))
+            children[name] = proc
+            backends[name] = ("127.0.0.1", port)
+        registry = MetricsRegistry()
+        router = FleetRouter(backends, owner="d0",
+                             config=RouterConfig(max_attempts=3,
+                                                 failover_wait_s=15.0),
+                             registry=registry)
+
+        # -- write soak with a mid-run SIGKILL of the owner ------------
+        soak_s = min(6.0, max(3.0, _remaining() - 120))
+        kill_after_s = soak_s / 3.0
+        reads = {"ok": 0, "fail": 0}
+        stop = threading.Event()
+
+        def reader(j):
+            while not stop.is_set():
+                try:
+                    router.query(q_read, {"min": 20 + (j % n_ids)},
+                                 family=f"fam-{j}")
+                    reads["ok"] += 1
+                except ServeError:
+                    reads["fail"] += 1
+                time.sleep(0.005)
+
+        readers = [threading.Thread(target=reader, args=(j,), daemon=True)
+                   for j in range(2)]
+        for t in readers:
+            t.start()
+
+        acked = []
+        killed_at = None
+        recovered_at = None
+        t0 = time.perf_counter()
+        seq = 0
+        while time.perf_counter() - t0 < soak_s and _remaining() > 60:
+            now = time.perf_counter() - t0
+            if killed_at is None and now >= kill_after_s:
+                children["d0"].kill()  # SIGKILL, no drain, no fsync
+                killed_at = time.perf_counter()
+            params = {"id": 1 + seq % n_ids, "v": seq}
+            try:
+                # ship=False: peers catch up from the WAL at election
+                # time; shipping every soak write would hide the log's
+                # role in the recovery measurement
+                router.write(q_write, params, ship=False)
+            except ServeError:
+                time.sleep(0.02)
+                continue  # retry the SAME idempotent write until acked
+            acked.append(params)
+            if killed_at is not None and recovered_at is None:
+                recovered_at = time.perf_counter()
+            seq += 1
+        stop.set()
+        for t in readers:
+            t.join()
+        recovery_s = ((recovered_at - killed_at)
+                      if killed_at and recovered_at else float("nan"))
+        availability = (reads["ok"] / (reads["ok"] + reads["fail"])
+                        if (reads["ok"] + reads["fail"]) else 0.0)
+
+        # -- zero acked-write loss: digest parity vs a serial oracle ---
+        oracle_session = caps_tpu.local_session(backend="local")
+        oracle = VersionedGraph(oracle_session,
+                                create_graph(oracle_session, create))
+        for params in acked:
+            oracle_session.cypher_on_graph(oracle, q_write, params)
+        oracle_digest = rows_digest(
+            oracle_session.cypher_on_graph(oracle, q_all).to_maps())
+        survivor = router._clients[router.owner].call(
+            "query", query=q_all, params={}, digest=True)
+        digest_match = survivor["digest"] == oracle_digest
+
+        # -- the fence: a restarted zombie owner applies nothing -------
+        proc, port = spawn_backend(durable_spec("d0"))
+        children["d0"] = proc
+        router.write(q_write, {"id": 1, "v": seq}, ship=False)  # renew
+        acked.append({"id": 1, "v": seq})
+        fenced = []
+        with WireClient("127.0.0.1", port) as zombie:
+            version_before = zombie.call("ping")["snapshot_version"]
+            for stale in (1, None):
+                try:
+                    fields = {} if stale is None else {"epoch": stale}
+                    zombie.call("write", query=q_write,
+                                params={"id": 2, "v": 10_000}, **fields)
+                    fenced.append("APPLIED")
+                except StaleEpoch:
+                    fenced.append("StaleEpoch")
+            version_after = zombie.call("ping")["snapshot_version"]
+        zero_stale_writes = (fenced == ["StaleEpoch", "StaleEpoch"]
+                            and version_after == version_before)
+
+        # -- sharded commits: digest parity with an unsharded session --
+        shard_writes = (
+            ("CREATE (n:Person {id: 99, name: 'Zed', age: 1})", {}),
+            ("MATCH (p:Person {id: 2}) SET p.age = 90", {}),
+            ("MATCH (p:Person {id: 3}) DETACH DELETE p", {}),
+        )
+        s_sharded = caps_tpu.local_session(backend="local")
+        group = ShardGroup(
+            s_sharded, create_graph(s_sharded, create),
+            ShardGroupConfig(name="g0", members=2,
+                             partitions_per_member=2),
+            registry=s_sharded.metrics_registry)
+        s_plain = caps_tpu.local_session(backend="local")
+        plain = VersionedGraph(s_plain, create_graph(s_plain, create))
+        for q, p in shard_writes:
+            group.execute(q, p)
+            s_plain.cypher_on_graph(plain, q, p)
+        sharded_parity = (
+            result_digest(group.execute(q_all))
+            == result_digest(s_plain.cypher_on_graph(plain, q_all)))
+        group.close()
+
+        assert availability == 1.0, reads
+        assert digest_match, "acked writes lost across failover"
+        assert zero_stale_writes, fenced
+        assert sharded_parity, "sharded digest diverged from unsharded"
+        _result.update({
+            "metric": "durable-write failover: write owner SIGKILLed "
+                      "mid-soak, peer with longest replayed WAL claims "
+                      "the epoch-fenced lease (3 backend processes, "
+                      "shared durable store, fsync=always, "
+                      f"ttl={ttl_s:.0f}s, "
+                      f"{'tpu' if on_tpu else 'cpu'})",
+            "value": round(recovery_s, 3),
+            "unit": "s from SIGKILL to next acked write",
+            "acked_writes": len(acked),
+            "acked_write_loss": 0 if digest_match else -1,
+            "read_availability": availability,
+            "reads_served": reads["ok"],
+            "fence_probe": fenced,
+            "new_owner": router.owner,
+            "owner_epoch": router._owner_epoch,
+            "failovers": registry.snapshot().get("router.failovers", 0),
+            "sharded_parity": bool(sharded_parity),
+            "vs_baseline": 0.0,
+        })
+    finally:
+        if router is not None:
+            router.close()
+        for proc in children.values():
+            proc.kill()
+    _emit()
+
+
 def main():
     import numpy as np
     if len(sys.argv) > 1 and sys.argv[1] == "serve" \
@@ -2496,6 +2705,8 @@ def main():
             i = sys.argv.index("--procs")
             procs_n = int(sys.argv[i + 1]) if i + 1 < len(sys.argv) else 4
         return run_fleet_config(on_tpu, procs_n)
+    if len(sys.argv) > 1 and sys.argv[1] == "durability":
+        return run_durability_config(on_tpu)
 
     from caps_tpu.backends.local.session import LocalCypherSession
     from caps_tpu.backends.tpu.session import TPUCypherSession

@@ -75,7 +75,8 @@ class AnalysisConfig:
     #: where locks live: the lock-order pass builds its graph from these
     lock_dirs: Tuple[str, ...] = (
         "caps_tpu/serve", "caps_tpu/obs", "caps_tpu/relational",
-        "caps_tpu/okapi", "caps_tpu/testing/faults.py")
+        "caps_tpu/okapi", "caps_tpu/durability",
+        "caps_tpu/testing/faults.py")
     #: the one sanctioned time source (exempt from clock-discipline)
     clock_exempt: Tuple[str, ...] = ("caps_tpu/obs/clock.py",)
     #: modules the clock-discipline pass MUST see — same vacuity guard
@@ -107,7 +108,7 @@ class AnalysisConfig:
     exception_markers: frozenset = frozenset({
         "caps_failed_op", "caps_device_index", "caps_transient",
         "caps_device_fault", "caps_shard_member", "caps_wcoj_fault",
-        "caps_algo_fault", "caps_stale_cache"})
+        "caps_algo_fault", "caps_stale_cache", "caps_wal_fault"})
     #: sanctioned first segments of dotted metric names
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
@@ -115,7 +116,7 @@ class AnalysisConfig:
         "updates", "compaction", "telemetry", "slo", "opstats",
         "compile", "mem", "slowlog", "warmup", "bucket", "planstore",
         "cost", "stats", "replan", "shard", "paging", "wcoj",
-        "fleet", "router", "wire", "rescache", "algo"})
+        "fleet", "router", "wire", "rescache", "algo", "wal"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)

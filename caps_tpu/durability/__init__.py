@@ -1,0 +1,18 @@
+"""Durable writes: the write-ahead commit log and the owner write lease.
+
+This package is the disk tier under the serving stack: `wal` persists
+every acknowledged commit as the exact cumulative delta payload snapshot
+shipping already moves between fleet peers, and `lease` arbitrates which
+fleet backend may accept writes (epoch-fenced, so a deposed owner can
+never split-brain).  Everything here is host-side JSON — compiled
+executables and device buffers never touch the log (docs/tpu.md).
+"""
+from caps_tpu.durability.lease import LeaseStore
+from caps_tpu.durability.wal import (CommitLog, WalRecovery,
+                                     compose_delta_payloads,
+                                     empty_payload, scan_durable_dir)
+
+__all__ = [
+    "CommitLog", "LeaseStore", "WalRecovery", "compose_delta_payloads",
+    "empty_payload", "scan_durable_dir",
+]

@@ -630,6 +630,19 @@ class VersionedGraph(RelationalCypherGraph):
         self._compaction_folded = registry.counter(
             "compaction.folded_rows")
         self._compaction_s = registry.histogram("compaction.duration_s")
+        #: durability seam (caps_tpu/durability): ``pre_publish(new_snap)``
+        #: runs under the commit lock after the new snapshot is BUILT
+        #: but before it publishes — the WAL's append-before-acknowledge
+        #: point and the shard group's prepare/commit round.  A raise
+        #: rolls the string pool back and aborts the commit with the
+        #: graph untouched (same containment as a device-build failure).
+        self.pre_publish = None
+        #: ``on_compacted(folded_snap, new_snap)`` runs under the commit
+        #: lock right after a compaction publishes — the WAL's
+        #: checkpoint-truncation point.  Compaction is already durable
+        #: in the log (entries are cumulative), so the hook must treat
+        #: checkpoint failures as deferrable, never abort the fold.
+        self.on_compacted = None
         _register_delta_gauge(registry, self)
 
     # -- read surface --------------------------------------------------
@@ -704,6 +717,7 @@ class VersionedGraph(RelationalCypherGraph):
         columns rolls the pool back and re-raises with the graph
         untouched (the failure-atomicity seam the abort_write fault
         injector exercises)."""
+        compaction = base is not None
         pool = getattr(getattr(self._session, "backend", None), "pool",
                        None)
         mark = pool.mark() if pool is not None else None
@@ -720,7 +734,20 @@ class VersionedGraph(RelationalCypherGraph):
             raise
         new_snap = GraphSnapshot(self._session, base, delta_graph, state,
                                  snap.snapshot_version + 1, handle=self)
+        if not compaction and self.pre_publish is not None:
+            # append-before-acknowledge: a failed WAL append (or a
+            # failed shard prepare round) aborts the whole commit here,
+            # with the same pool rollback as a device-build failure
+            try:
+                self.pre_publish(new_snap)
+            except BaseException:
+                if pool is not None:
+                    pool.rollback(mark)
+                self._rolled_back.inc()
+                raise
         self._current = new_snap
+        if compaction and self.on_compacted is not None:
+            self.on_compacted(snap, new_snap)
         return new_snap
 
     def install_state(self, state: DeltaState, version: int,

@@ -152,10 +152,13 @@ class ReplicationUnsupported(ServeError):
 
 
 class ShardingUnsupported(ServeError):
-    """A graph or query that cannot be served by a shard group
-    (serve/shards.py): non-scan graphs cannot partition, and writes
-    against a partitioned graph are rejected — the commit lock does
-    not shard.  Classified FATAL: retrying cannot change it."""
+    """A graph that cannot be served by a shard group (serve/shards.py):
+    only scan-backed graphs partition, and a group manages its OWN
+    versioned write lineage — handing it an externally versioned graph
+    would split the commit history two ways.  Writes themselves are
+    served: the sharded commit protocol splits staged ops per shard and
+    commits them atomically at the group's WAL append.  Classified
+    FATAL: retrying cannot change it."""
 
 
 class ShardMemberDown(ServeError):
@@ -274,6 +277,55 @@ class FleetUnavailable(ServeError):
     def _rebuild(cls, payload: Dict[str, Any]) -> "FleetUnavailable":
         return cls(str(payload.get("message", "")),
                    retry_after_s=float(payload.get("retry_after_s", 0.0)))
+
+
+class WalWriteError(ServeError):
+    """A write-ahead-log append (or its fsync) failed BEFORE the commit
+    acknowledged (caps_tpu/durability/wal.py).  The commit rolls back
+    through the string-pool mark and this error surfaces to the writer —
+    a durability failure is NEVER a silent ack.  Marked
+    ``caps_transient``: disk pressure and injected fsync faults are
+    retryable; the graph itself is untouched."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.caps_transient = True
+
+
+class StaleEpoch(ServeError):
+    """An epoch-fenced write frame was refused (caps_tpu/durability):
+    the backend no longer holds the write lease, or the frame carries an
+    epoch older than the lease's.  This is the split-brain fence — a
+    zombie owner (or a router with a stale ownership view) learns who
+    actually owns writes from the carried fields and re-routes.
+    Classified FATAL on purpose: blind retry against the same backend
+    cannot succeed; the caller must re-elect."""
+
+    def __init__(self, message: str, epoch: Optional[int] = None,
+                 lease_epoch: Optional[int] = None,
+                 owner: Optional[str] = None):
+        super().__init__(message)
+        #: the epoch the refused frame carried (None = frame had none)
+        self.epoch = epoch
+        #: the live lease's epoch at refusal time
+        self.lease_epoch = lease_epoch
+        #: the live lease's owner — where writes actually go now
+        self.owner = owner
+
+    def _payload_fields(self) -> Dict[str, Any]:
+        return {"epoch": self.epoch, "lease_epoch": self.lease_epoch,
+                "owner": self.owner}
+
+    @classmethod
+    def _rebuild(cls, payload: Dict[str, Any]) -> "StaleEpoch":
+        epoch = payload.get("epoch")
+        lease_epoch = payload.get("lease_epoch")
+        owner = payload.get("owner")
+        return cls(str(payload.get("message", "")),
+                   epoch=None if epoch is None else int(epoch),
+                   lease_epoch=(None if lease_epoch is None
+                                else int(lease_epoch)),
+                   owner=None if owner is None else str(owner))
 
 
 def _error_classes() -> Dict[str, type]:

@@ -36,11 +36,14 @@ import sys
 import threading
 from typing import Any, Dict, Optional, Tuple
 
+from caps_tpu.durability.lease import LeaseStore
+from caps_tpu.durability.wal import (CommitLog, compose_delta_payloads,
+                                     empty_payload, scan_durable_dir)
 from caps_tpu.obs import clock
 from caps_tpu.obs.lockgraph import make_lock
 from caps_tpu.serve import wire
 from caps_tpu.serve.errors import (QueryFailed, ReplicationUnsupported,
-                                   ServerClosed)
+                                   ServerClosed, StaleEpoch, WalWriteError)
 from caps_tpu.serve.server import QueryServer, ServerConfig
 from caps_tpu.serve.warmup import WarmupConfig
 
@@ -90,6 +93,17 @@ class BackendSpec:
     #: The hash-ring's (graph, plan-family) affinity already routes a
     #: hot family to one process, so its entries stay process-resident.
     result_cache_budget: Optional[int] = None
+    #: shared durable directory (the store the PlanStore already lives
+    #: in): this backend's WAL goes to ``<durable_dir>/wal-<name>/`` and
+    #: the fleet's write lease to ``<durable_dir>/lease.json``.  None =
+    #: memory-only serving (the pre-durability behavior).
+    durable_dir: Optional[str] = None
+    #: WAL fsync policy: "always" | "rotate" | "never"
+    #: (caps_tpu/durability/wal.py)
+    wal_fsync: str = "always"
+    #: write-lease TTL: how long after the owner's last renewal a peer
+    #: may steal the lease (failover detection horizon)
+    lease_ttl_s: float = 5.0
     host: str = "127.0.0.1"
     #: 0 = ephemeral (the listener reports the bound port)
     port: int = 0
@@ -190,6 +204,17 @@ class FleetBackend:
                                 warmup=warmup,
                                 result_cache=rescache))
         self._registry = session.metrics_registry
+        #: durability (caps_tpu/durability): WAL + lease, or None when
+        #: the spec has no durable_dir / the graph is not versioned
+        self.wal: Optional[CommitLog] = None
+        self.lease: Optional[LeaseStore] = None
+        #: the lease epoch this backend last wrote under (stamped on
+        #: write acks so routers can fence their own staleness)
+        self.write_epoch: Optional[int] = None
+        self._base_overlay: Optional[Dict[str, Any]] = None
+        if (spec.durable_dir is not None
+                and getattr(self.graph, "graph_is_versioned", False)):
+            self._init_durability()
         self._shutting_down = threading.Event()
         self._conn_threads = []
         self._conns = []
@@ -199,6 +224,94 @@ class FleetBackend:
         self.port: Optional[int] = None
         if start:
             self.start()
+
+    # -- durability ----------------------------------------------------
+
+    def _init_durability(self) -> None:
+        """Open the WAL and lease on the shared durable store, then
+        CRASH-RECOVER before serving: replay this backend's own log
+        over the spec'd base (entries are cumulative, so the single
+        highest intact entry IS the recovered state) and hook the
+        commit path for append-before-acknowledge."""
+        from caps_tpu.relational.updates import delta_state_from_payload
+        spec = self.spec
+        self.wal = CommitLog(
+            os.path.join(spec.durable_dir, f"wal-{spec.name}"),
+            fsync=spec.wal_fsync, registry=self._registry,
+            event_log=getattr(self.session, "event_log", None))
+        self.lease = LeaseStore(spec.durable_dir, ttl_s=spec.lease_ttl_s,
+                                registry=self._registry)
+        self._base_overlay = empty_payload()
+        rec = self.wal.recover()
+        if rec.version > 0:
+            self.graph.install_state(
+                delta_state_from_payload(rec.state), rec.version)
+        self.graph.pre_publish = self._wal_append
+        self.graph.on_compacted = self._wal_checkpoint
+
+    def _cumulative_payload(self, snap) -> Dict[str, Any]:
+        """``snap``'s state as a payload cumulative over the SPEC'D
+        base: compaction folds the overlay into a new base, so states
+        after a fold are composed back over what was folded away —
+        recovery always replays onto a freshly spec-built graph."""
+        from caps_tpu.relational.updates import delta_state_to_payload
+        return compose_delta_payloads(self._base_overlay,
+                                      delta_state_to_payload(snap.state))
+
+    def _wal_append(self, new_snap) -> None:
+        """``pre_publish`` hook: the append-before-acknowledge point.
+        Runs under the commit lock before the snapshot swap; a failed
+        append raises WalWriteError and the commit rolls back — the
+        writer never sees an ack for a frame that did not land."""
+        self.wal.append(new_snap.snapshot_version,
+                        self._cumulative_payload(new_snap),
+                        epoch=self.write_epoch)
+
+    def _wal_checkpoint(self, folded_snap, new_snap) -> None:
+        """``on_compacted`` hook: fold the compacted-away overlay into
+        the base composition, persist it as the checkpoint, truncate
+        covered segments.  A checkpoint write failure is deferred, not
+        fatal: entries stay cumulative over the spec'd base, so recovery
+        is exact from the un-truncated log alone."""
+        from caps_tpu.relational.updates import delta_state_to_payload
+        self._base_overlay = compose_delta_payloads(
+            self._base_overlay, delta_state_to_payload(folded_snap.state))
+        try:
+            self.wal.checkpoint(new_snap.snapshot_version,
+                                self._base_overlay, epoch=self.write_epoch)
+        except WalWriteError:
+            self._registry.counter("wal.checkpoint_failures").inc()
+
+    def _fence_write(self, frame_epoch: Optional[int]) -> None:
+        """The split-brain fence, checked before EVERY durable write:
+        (a) this backend must hold the live lease (a deposed zombie
+        owner reads the shared lease file and learns it does not), and
+        (b) the frame's epoch, when carried, must match the lease's (a
+        router with a stale ownership view is told who owns writes
+        now).  An unheld lease is claimed on first write — initial
+        ownership needs no ceremony."""
+        lease = self.lease.read()
+        if lease is None or self.lease.expired(lease):
+            epoch = self.lease.acquire(self.spec.name)
+            if epoch is not None:
+                self.write_epoch = epoch
+                lease = self.lease.read()
+            else:
+                lease = self.lease.read()
+        if lease is None or lease["owner"] != self.spec.name:
+            self._registry.counter("wal.fenced_writes").inc()
+            raise StaleEpoch(
+                f"backend {self.spec.name!r} does not hold the write "
+                f"lease", epoch=frame_epoch,
+                lease_epoch=None if lease is None else lease["epoch"],
+                owner=None if lease is None else lease["owner"])
+        self.write_epoch = lease["epoch"]
+        if frame_epoch is not None and int(frame_epoch) != lease["epoch"]:
+            self._registry.counter("wal.fenced_writes").inc()
+            raise StaleEpoch(
+                f"stale-epoch write frame fenced at backend "
+                f"{self.spec.name!r}", epoch=int(frame_epoch),
+                lease_epoch=lease["epoch"], owner=lease["owner"])
 
     # -- listener ------------------------------------------------------
 
@@ -323,15 +436,53 @@ class FleetBackend:
     def _op_write(self, msg) -> Dict[str, Any]:
         """An update query against the owned versioned graph; the reply
         carries the post-commit version so the router can measure
-        snapshot lag per peer."""
+        snapshot lag per peer.  Durable backends fence the frame's
+        epoch first (StaleEpoch — never execute a zombie's write) and
+        acknowledge only after the WAL append landed (the pre_publish
+        hook runs inside the commit)."""
         if not getattr(self.graph, "graph_is_versioned", False):
             raise ReplicationUnsupported(
                 f"backend {self.spec.name!r} serves a non-versioned "
                 f"graph; writes need a versioned owner")
+        if self.lease is not None:
+            self._fence_write(msg.get("epoch"))
         rows, info = self._submit(msg)
-        return {"rows": rows,
-                "version": self.graph.current().snapshot_version,
-                "queue_depth": self.server.admission.depth()}
+        out = {"rows": rows,
+               "version": self.graph.current().snapshot_version,
+               "queue_depth": self.server.admission.depth()}
+        if self.lease is not None:
+            out["epoch"] = self.write_epoch
+            self.lease.renew(self.spec.name)
+        return out
+
+    def _op_acquire_lease(self, msg) -> Dict[str, Any]:
+        """Failover: make THIS backend the write owner.  First replay
+        every backend's WAL under the shared store (the dead owner's
+        acked-but-unshipped writes live only in ITS log — zero
+        acknowledged-write loss), then claim the epoch-fenced lease,
+        polling up to ``wait_s`` for the dead owner's TTL to lapse.
+        Non-durable backends answer ``durable: False`` so the router
+        can keep the legacy read-only-until-rejoin behavior."""
+        if self.lease is None:
+            return {"durable": False, "epoch": None,
+                    "version": self._snapshot_version()}
+        from caps_tpu.relational.updates import delta_state_from_payload
+        best = scan_durable_dir(self.spec.durable_dir,
+                                registry=self._registry)
+        if (best is not None
+                and best.version > (self._snapshot_version() or 0)):
+            self.graph.install_state(
+                delta_state_from_payload(best.state), best.version)
+            self._registry.counter("wal.failover_replays").inc()
+        deadline = clock.now() + float(msg.get("wait_s") or 0.0)
+        epoch = self.lease.acquire(self.spec.name)
+        while epoch is None and clock.now() < deadline:
+            clock.sleep(min(0.05, max(self.spec.lease_ttl_s / 4.0, 0.005)))
+            epoch = self.lease.acquire(self.spec.name)
+        if epoch is not None:
+            self.write_epoch = epoch
+        return {"durable": True, "epoch": epoch,
+                "version": self._snapshot_version()}
 
     def _op_export_delta(self, msg) -> Dict[str, Any]:
         """Replication source: the current snapshot's full delta state.
@@ -358,6 +509,17 @@ class FleetBackend:
                 f"graph; cannot install snapshots")
         with wire.WireClient(str(msg["host"]), int(msg["port"]),
                              timeout_s=30.0) as owner:
+            if self.wal is not None:
+                # WAL-tail rejoin: this backend's own recovered log may
+                # already be current (it held every acked write when it
+                # died) — compare versions before paying for a full
+                # cumulative-delta pull
+                owner_version = owner.call("ping").get("snapshot_version")
+                local_version = self.graph.current().snapshot_version
+                if (owner_version is not None
+                        and local_version >= int(owner_version)):
+                    self._registry.counter("wal.catchups").inc()
+                    return {"version": local_version, "wal_catchup": True}
             delta = owner.call("export_delta")
         state = delta_state_from_payload(delta["state"])
 
@@ -371,6 +533,18 @@ class FleetBackend:
             self._registry.counter("fleet.snapshots_installed").inc()
             self._registry.gauge("fleet.snapshot_version").set(
                 float(new_snap.snapshot_version))
+            if self.wal is not None:
+                # best-effort peer durability: shipped snapshots land in
+                # THIS backend's log too, so "longest replayed log" at
+                # election time favors the most caught-up peer.  A peer
+                # disk hiccup must never fail replication — the owner's
+                # log still holds the entry.
+                try:
+                    self.wal.append(new_snap.snapshot_version,
+                                    self._cumulative_payload(new_snap))
+                except WalWriteError:
+                    self._registry.counter(
+                        "wal.peer_append_failures").inc()
 
         snap = self.graph.install_state(state, int(delta["version"]),
                                         on_install=_publish)

@@ -31,7 +31,11 @@ traffic again.
 owner's delta snapshot to every live peer (peers pull from the owner
 directly — the router only coordinates) and measures the lag
 (``fleet.snapshot_lag_s``): the read-your-writes bound a client
-observes across the whole fleet.
+observes across the whole fleet.  On a durable fleet
+(caps_tpu/durability) owner death triggers an election instead of
+read-only mode: the peer with the longest replayed log claims the
+epoch-fenced lease, and every write frame carries the router's epoch so
+a stale view (or a zombie owner) is fenced, never split-brained.
 """
 from __future__ import annotations
 
@@ -45,7 +49,7 @@ from caps_tpu.obs.lockgraph import make_rlock
 from caps_tpu.obs.metrics import (MetricsRegistry, global_registry,
                                   merge_snapshots)
 from caps_tpu.serve.errors import (FleetUnavailable, Overloaded, ServeError,
-                                   ServerClosed, WireError)
+                                   ServerClosed, StaleEpoch, WireError)
 from caps_tpu.serve.wire import WireClient
 
 _UNSET = object()
@@ -133,6 +137,9 @@ class RouterConfig:
     max_attempts: int = 3
     #: per-call wire timeout
     timeout_s: float = 60.0
+    #: how long a failover election waits for the dead owner's lease
+    #: TTL to lapse before giving up (durable fleets only)
+    failover_wait_s: float = 10.0
 
 
 class FleetRouter:
@@ -153,6 +160,10 @@ class FleetRouter:
         self.owner = owner if owner is not None else next(iter(backends))
         if self.owner not in self._addrs:
             raise FleetUnavailable(f"owner {self.owner!r} is not a backend")
+        #: the lease epoch writes are stamped with (durable fleets):
+        #: learned from write acks and failover elections, fenced by the
+        #: backends — a router holding a stale view is told so
+        self._owner_epoch: Optional[int] = None
         self.ring = HashRing(backends.keys(), vnodes=self.config.vnodes)
         self._clients = {name: WireClient(host, port,
                                           timeout_s=self.config.timeout_s)
@@ -297,23 +308,93 @@ class FleetRouter:
               ship: bool = True) -> Dict[str, Any]:
         """Route one write to the owner, then ship its post-commit
         snapshot to every live peer.  The reply carries the committed
-        ``version`` and the shipping report (per-peer version + lag)."""
+        ``version`` and the shipping report (per-peer version + lag).
+
+        **Failover** (durable fleets): when the owner is dead, the
+        router elects the live peer with the longest replayed log and
+        has it claim the epoch-fenced lease (waiting out the dead
+        owner's TTL), then retries the write there.  Every write frame
+        carries the router's known epoch, so a stale ownership view is
+        fenced by the backend (:class:`StaleEpoch`) and corrected from
+        the error's fields.  Non-durable fleets keep the legacy
+        behavior: owner death makes the fleet read-only until rejoin."""
         if not self._state[self.owner]["live"]:
-            raise FleetUnavailable(
-                f"write owner {self.owner!r} is down — the fleet is "
-                f"read-only until it rejoins")
-        try:
-            reply = self._clients[self.owner].call(
-                "write", query=query, params=parameters or {})
-        except WireError:
-            self.mark_dead(self.owner)
-            raise FleetUnavailable(
-                f"write owner {self.owner!r} failed mid-write")
-        self._note_reply(self.owner, reply)
-        self.registry.counter("router.writes").inc()
-        if ship:
-            reply["ship"] = self.ship_snapshots()
-        return reply
+            if not self._failover_owner():
+                raise FleetUnavailable(
+                    f"write owner {self.owner!r} is down — the fleet is "
+                    f"read-only until it rejoins")
+        for attempt in (0, 1):
+            fields: Dict[str, Any] = {"query": query,
+                                      "params": parameters or {}}
+            if self._owner_epoch is not None:
+                fields["epoch"] = self._owner_epoch
+            try:
+                reply = self._clients[self.owner].call("write", **fields)
+            except WireError:
+                dead = self.owner
+                self.mark_dead(dead)
+                if attempt or not self._failover_owner():
+                    raise FleetUnavailable(
+                        f"write owner {dead!r} failed mid-write")
+                continue
+            except StaleEpoch as ex:
+                # the lease names the true owner — adopt and retry once
+                self.registry.counter("router.stale_epochs").inc()
+                if (attempt or ex.owner is None
+                        or ex.owner not in self._addrs
+                        or not self._state[ex.owner]["live"]):
+                    raise
+                with self._lock:
+                    self.owner = ex.owner
+                    self._owner_epoch = ex.lease_epoch
+                continue
+            if isinstance(reply, dict) and reply.get("epoch") is not None:
+                self._owner_epoch = int(reply["epoch"])
+            self._note_reply(self.owner, reply)
+            self.registry.counter("router.writes").inc()
+            if ship:
+                reply["ship"] = self.ship_snapshots()
+            return reply
+        raise FleetUnavailable(  # pragma: no cover — loop always exits
+            f"write owner {self.owner!r} failed mid-write")
+
+    def _failover_owner(self) -> bool:
+        """Elect a new write owner after owner death (durable fleets):
+        the live peer with the longest replayed log wins (max snapshot
+        version, ties by name), replays every backend's WAL tail from
+        the shared store, and claims the epoch-fenced lease — polling
+        until the dead owner's TTL lapses.  False when the fleet has no
+        durability (legacy read-only-until-rejoin) or nobody can win."""
+        candidates = []
+        for name in sorted(self._addrs):
+            if name == self.owner or not self._state[name]["live"]:
+                continue
+            try:
+                version = self._clients[name].call(
+                    "ping").get("snapshot_version")
+            except WireError:
+                self.mark_dead(name)
+                continue
+            if version is not None:
+                candidates.append((-int(version), name))
+        candidates.sort()
+        for _neg_version, name in candidates:
+            try:
+                out = self._clients[name].call(
+                    "acquire_lease", wait_s=self.config.failover_wait_s)
+            except WireError:
+                self.mark_dead(name)
+                continue
+            if not out.get("durable"):
+                return False  # no lease machinery anywhere in this fleet
+            if out.get("epoch") is None:
+                continue  # lost the epoch CAS — try the next-longest log
+            with self._lock:
+                self.owner = name
+                self._owner_epoch = int(out["epoch"])
+            self.registry.counter("router.failovers").inc()
+            return True
+        return False
 
     def ship_snapshots(self) -> Dict[str, Any]:
         """Bring every live peer current with the owner: each peer

@@ -565,6 +565,10 @@ class QueryServer:
             # the handle (they serialize on its commit lock and always
             # see the latest state).  Resolve BEFORE keying so the
             # admission path computes the batch key exactly once.
+            # Shard-group graphs never reach this branch: the group
+            # versions its partitions INTERNALLY (serve/shards.py), so
+            # writes pass through untouched and commit via the group's
+            # own lineage inside ShardGroup.execute.
             from caps_tpu.relational.updates import is_update_query
             if not is_update_query(query):
                 graph = graph.current()

@@ -54,6 +54,23 @@ devices fronting ONE hash-partitioned graph, mixed into the same
   ``paging.faults`` / ``paging.spills`` counters and
   ``paging.resident_bytes`` / ``paging.host_bytes`` gauges account it.
 
+* **Sharded writes** (the durable-writes PR): Cypher CREATE / SET /
+  DELETE through the group commits on an INTERNAL versioned lineage
+  over the cross-shard clone — the session's normal write path, so
+  staging, failure atomicity, and digest parity with an unsharded
+  versioned graph hold by construction — and distributes each commit
+  to the member shards through a prepare/commit round
+  (:meth:`ShardGroup._prepare_commit`): the new overlay splits per
+  shard along :func:`partition_graph`'s exact placement, every
+  resident partition's new overlay graph builds under that member's
+  string-pool mark (prepare — ANY failure rolls every member back and
+  aborts the commit with no shard partially applied), the group WAL
+  append is the commit point when the group is durable
+  (``ShardGroupConfig.wal_dir``), and only then do the prepared
+  overlays swap in — pure reference swaps that cannot fail.  Routed
+  single-shard reads see writes through their member's overlay;
+  cross-shard reads resolve the lineage's current snapshot.
+
 Locking: the group serves ONE dispatch stream (``self.lock``, held by
 the server exactly like a replica's execution lock); every residency
 mutation (fault-in, spill, rebuild) happens under it, so the pager
@@ -64,6 +81,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import threading
 import zlib
 from collections import OrderedDict
@@ -227,13 +245,16 @@ def _table_host_columns(table) -> Dict[str, List[Any]]:
 
 
 def partition_graph(graph, n_partitions: int,
-                    partition_property: str = "id"
+                    partition_property: str = "id",
+                    home_out: Optional[Dict[int, int]] = None
                     ) -> List[GraphPartition]:
     """Hash-partition a scan graph's rows into ``n_partitions`` host
     slices.  Node rows hash by ``partition_property``'s value when the
     table maps that property (else by node id); relationship rows
     follow their source node's partition, so each partition's CSR holds
-    the edges fanning out of its own nodes."""
+    the edges fanning out of its own nodes.  ``home_out`` (when given)
+    receives the node-id -> partition map the split decided — the
+    sharded commit protocol routes delta tombstones with it."""
     from caps_tpu.relational.graphs import ScanGraph
     if not isinstance(graph, ScanGraph):
         raise ShardingUnsupported(
@@ -282,6 +303,8 @@ def partition_graph(graph, n_partitions: int,
                 rt.mapping,
                 {c: [vals[i] for i in rows] for c, vals in cols.items()},
                 types, len(rows)))
+    if home_out is not None:
+        home_out.update(node_home)
     out = []
     for p in range(n):
         out.append(GraphPartition(
@@ -322,6 +345,14 @@ class ShardGroupConfig:
     #: (or on backends without a mesh) the cross session is a plain
     #: full-graph clone — same results, no capacity win for that path
     cross_shard_mesh: bool = True
+    #: durable writes (caps_tpu/durability): when set, every group
+    #: commit appends its cumulative overlay to a group WAL under
+    #: ``{wal_dir}/wal-shard-{name}`` BEFORE the prepared overlays swap
+    #: in, and a fresh group over the same directory recovers the
+    #: lineage on construction
+    wal_dir: Optional[str] = None
+    #: group WAL fsync policy (``"always"`` / ``"rotate"`` / ``"never"``)
+    wal_fsync: str = "rotate"
 
 
 # -- members -----------------------------------------------------------------
@@ -341,6 +372,11 @@ class ShardMember:
         #: The cost is the partition's HOST-slice estimate — one stable
         #: currency for every budget decision, known before first build.
         self.resident: "OrderedDict[int, Tuple[Any, int]]" = OrderedDict()
+        #: pidx -> the UNWRAPPED base partition graph behind a resident
+        #: entry (the sharded commit protocol re-anchors each commit's
+        #: shard overlay on it; identical to the resident graph until
+        #: the first write touches the shard)
+        self.base_graphs: Dict[int, Any] = {}
         #: pidx -> measured device-table bytes (reporting; populated at
         #: each build)
         self.measured_nbytes: Dict[int, int] = {}
@@ -454,9 +490,10 @@ class ShardGroup:
             raise ShardingUnsupported("a shard group needs >= 1 member")
         if getattr(graph, "graph_is_versioned", False):
             raise ShardingUnsupported(
-                "shard groups serve static scan graphs; versioned "
-                "graphs stay on replica members (writes need the "
-                "commit lock, which does not shard)")
+                "shard groups partition static scan graphs and version "
+                "them INTERNALLY (writes commit through the group's own "
+                "lineage); an externally versioned input would split "
+                "the commit lock across two handles")
         self.config = config
         self.name = config.name
         self.graph = graph
@@ -472,8 +509,12 @@ class ShardGroup:
         self._state_lock = make_lock("shards.ShardGroup._state_lock")
         n = config.members
         n_parts = n * max(1, config.partitions_per_member)
+        #: node id -> partition of the BASE rows (tombstone routing in
+        #: the sharded commit split)
+        self._node_home: Dict[int, int] = {}
         self.partitions = partition_graph(graph, n_parts,
-                                          config.partition_property)
+                                          config.partition_property,
+                                          home_out=self._node_home)
         self.members: List[ShardMember] = [
             ShardMember(i, self._member_session(),
                         [p for p in range(n_parts) if p % n == i])
@@ -486,6 +527,24 @@ class ShardGroup:
         from caps_tpu.serve.devices import replicate_graph
         with self._bracket(None):
             self.cross_graph = replicate_graph(graph, self.cross_session)
+        #: the group's OWN versioned lineage over the cross-shard clone:
+        #: writes commit here through the session's normal write path
+        #: (digest parity with an unsharded versioned graph by
+        #: construction) and distribute to the member shards via the
+        #: prepare/commit round before publishing.  The lineage never
+        #: compacts — a fold would move delta rows into the cross base
+        #: without re-partitioning the member shards.
+        from caps_tpu.relational.updates import VersionedGraph
+        with self._bracket(None):
+            self._versioned = VersionedGraph(self.cross_session,
+                                             self.cross_graph)
+        #: pidx -> that shard's slice of the current delta overlay
+        #: (only shards with a non-empty slice appear)
+        self._shard_states: Dict[int, Any] = {}
+        self.wal = None
+        if config.wal_dir is not None:
+            self._init_durability()
+        self._versioned.pre_publish = self._prepare_commit
         self._facade = _GroupSessionFacade(self)
         #: member + group ladder: the same three-state breaker machine
         #: as the device ladder, group-scoped metric prefix
@@ -510,6 +569,10 @@ class ShardGroup:
         self._group_quarantined_c = registry.counter(
             "shard.group_quarantined")
         self._shed_c = registry.counter("shard.shed")
+        self._requests_write = registry.counter("shard.requests.write")
+        self._commits_c = registry.counter("shard.commits")
+        self._commit_rollbacks_c = registry.counter(
+            "shard.commit_rollbacks")
         self._faults_c = registry.counter("paging.faults")
         self._spills_c = registry.counter("paging.spills")
         self._route_cache: "OrderedDict[str, Optional[Tuple]]" = \
@@ -609,6 +672,26 @@ class ShardGroup:
                 pass           # fall through to the unmeshed clone
         return self.template_session.clone(), False
 
+    def _init_durability(self) -> None:
+        """Open the group WAL and recover the lineage from it: the best
+        intact entry (entries are cumulative — the group lineage never
+        compacts, so they overlay the spec'd base directly) installs
+        into the internal versioned handle at its logged version, and
+        the recovered overlay re-splits per shard so the eager ingest
+        below wraps every resident partition at the recovered state."""
+        from caps_tpu.durability import CommitLog
+        from caps_tpu.relational.updates import delta_state_from_payload
+        self.wal = CommitLog(
+            os.path.join(self.config.wal_dir, f"wal-shard-{self.name}"),
+            fsync=self.config.wal_fsync, registry=self._registry,
+            event_log=self._event_log)
+        rec = self.wal.recover()
+        if rec.version > 0:
+            state = delta_state_from_payload(rec.state)
+            with self._bracket(None):
+                self._versioned.install_state(state, rec.version)
+            self._shard_states = self._split_state(state)
+
     # -- paging ---------------------------------------------------------
 
     def _partition_cost(self, pidx: int) -> int:
@@ -656,10 +739,20 @@ class ShardGroup:
                     self._device_pressure(member) + incoming > budget:
                 self._spill(member, next(iter(member.resident)))
         with self._bracket(member.index):
-            graph = self.partitions[pidx].build(member.session)
+            built = self.partitions[pidx].build(member.session)
+            graph = built
+            # re-anchor the shard's slice of the current delta overlay
+            # on the freshly built base: a spilled-then-faulted
+            # partition must come back at the lineage's CURRENT state
+            sstate = self._shard_states.get(pidx)
+            if sstate is not None:
+                graph = self._overlay_graph(
+                    member.session, built, sstate,
+                    self._versioned.current().snapshot_version)
+        member.base_graphs[pidx] = built
         from caps_tpu.obs.ledger import tables_nbytes
         member.measured_nbytes[pidx] = tables_nbytes(
-            tuple(graph.node_tables) + tuple(graph.rel_tables))
+            tuple(built.node_tables) + tuple(built.rel_tables))
         member.resident[pidx] = (graph, incoming)
         if count_fault:
             member.page_faults += 1
@@ -673,12 +766,15 @@ class ShardGroup:
         object — stale entries would only pin memory), and the host
         slice remains the truth."""
         graph, _nb = member.resident.pop(pidx)
-        token = getattr(graph, "_plan_token", None)
-        if token is not None:
-            try:
-                member.session.plan_cache.evict_graph(token)
-            except Exception:  # pragma: no cover — accounting only
-                pass
+        base = member.base_graphs.pop(pidx, None)
+        for g in (graph, base if base is not graph else None):
+            token = getattr(g, "_plan_token", None) if g is not None \
+                else None
+            if token is not None:
+                try:
+                    member.session.plan_cache.evict_graph(token)
+                except Exception:  # pragma: no cover — accounting only
+                    pass
         member.page_spills += 1
         self._spills_c.inc()
 
@@ -824,10 +920,7 @@ class ShardGroup:
         from caps_tpu.frontend.parser import query_mode
         mode, body = query_mode(query)
         if is_update_query(body if mode is not None else query):
-            raise ShardingUnsupported(
-                f"writes are not served by shard group {self.name!r}: "
-                f"partitioned graphs are read-only (route writes to a "
-                f"replica-served versioned graph)")
+            return self._execute_update(query, params, degraded)
         route = self._route(query)
         value: Any = None
         routed = False
@@ -876,7 +969,11 @@ class ShardGroup:
     def _execute_cross(self, query, params, degraded):
         self._requests_cross.inc()
         with self._bracket(None):
-            return self._run(self.cross_session, self.cross_graph,
+            # the lineage's current snapshot, not the static clone:
+            # cross-shard reads see every committed write (a snapshot
+            # is a stable plan-cache anchor exactly like the clone was)
+            return self._run(self.cross_session,
+                             self._versioned.current(),
                              query, params, degraded)
 
     @staticmethod
@@ -888,6 +985,150 @@ class ShardGroup:
                                            no_fused=no_fused)
         return session.cypher_on_graph(graph, query, params)
 
+    # -- sharded commits (the durable-writes protocol) ------------------
+
+    def _execute_update(self, query, params, degraded):
+        """A Cypher write through the group: the session's NORMAL write
+        path runs against the internal versioned lineage (same staging,
+        same failure atomicity, digest parity with an unsharded
+        versioned session by construction); publication runs the
+        prepare/commit round via the lineage's ``pre_publish`` hook."""
+        self._requests_write.inc()
+        with self._bracket(None):
+            return self._run(self.cross_session, self._versioned,
+                             query, params, degraded)
+
+    @staticmethod
+    def _overlay_graph(session, base, state, version):
+        """One shard's overlay: the member-local base partition plus
+        this shard's slice of the lineage's delta, as an ordinary
+        immutable snapshot (plan-cacheable per commit version)."""
+        from caps_tpu.relational.updates import (GraphSnapshot,
+                                                 build_delta_graph)
+        delta = build_delta_graph(session, state)
+        return GraphSnapshot(session, base, delta, state, version,
+                             handle=None)
+
+    def _split_state(self, state) -> Dict[int, Any]:
+        """Split one cumulative delta overlay into per-shard overlays,
+        mirroring :func:`partition_graph`'s placement exactly: delta
+        node records hash by their partition-property value (id-token
+        without one), delta relationships follow their source node's
+        CURRENT home, and tombstones go where the base row they mask
+        lives — a SET that moves the partition property emits the
+        record on the new home and the tombstone on the old, so a
+        routed query for either value answers correctly.  Shards whose
+        slice is empty are omitted."""
+        from caps_tpu.relational.updates import DeltaState
+        n = len(self.partitions)
+        prop = self.config.partition_property
+        delta_home: Dict[int, int] = {}
+        for rec in state.nodes:
+            v = rec.props_dict().get(prop)
+            delta_home[rec.id] = (hash_value(v) if v is not None
+                                  else hash_value(f"#id:{rec.id}")) % n
+
+        def base_home(nid: int) -> int:
+            got = self._node_home.get(nid)
+            return got if got is not None \
+                else hash_value(f"#id:{nid}") % n
+
+        def node_home(nid: int) -> int:
+            got = delta_home.get(nid)
+            return got if got is not None else base_home(nid)
+
+        hn: Dict[int, set] = {}
+        hr: Dict[int, set] = {}
+        nodes: Dict[int, List[Any]] = {}
+        rels: Dict[int, List[Any]] = {}
+        for rec in state.nodes:
+            nodes.setdefault(delta_home[rec.id], []).append(rec)
+        for rec in state.rels:
+            rels.setdefault(node_home(rec.src), []).append(rec)
+        for nid in state.hidden_nodes:
+            hn.setdefault(base_home(nid), set()).add(nid)
+        base_rels = self.graph.rel_lookup()
+        for rid in state.hidden_rels:
+            got = base_rels.get(rid)
+            p = base_home(got[0]) if got is not None \
+                else hash_value(f"#id:{rid}") % n
+            hr.setdefault(p, set()).add(rid)
+        out: Dict[int, Any] = {}
+        for p in set(hn) | set(hr) | set(nodes) | set(rels):
+            out[p] = DeltaState(
+                hidden_nodes=frozenset(hn.get(p, ())),
+                hidden_rels=frozenset(hr.get(p, ())),
+                nodes=tuple(nodes.get(p, ())),
+                rels=tuple(rels.get(p, ())))
+        return out
+
+    def _prepare_commit(self, new_snap) -> None:
+        """The prepare/commit round (``VersionedGraph.pre_publish`` —
+        the commit lock and the group's dispatch lock are both held).
+
+        **Prepare**: split the new cumulative overlay per shard and
+        build each changed resident partition's new overlay graph under
+        that member's string-pool mark.  Any failure — a device fault
+        on one member, an injected abort, a failed WAL append — rolls
+        EVERY member's pool back and aborts the commit; no shard is
+        ever partially applied (the outer publish rolls the cross
+        session back the same way).
+
+        **Commit point**: the group WAL append (durable groups).  An
+        acknowledged write is on disk before any reader can see it.
+
+        **Commit**: swap the prepared overlays in, member by member —
+        pure reference swaps that cannot fail — and evict each replaced
+        graph's plan-cache entries (a superseded shard overlay can
+        never be read again)."""
+        shard_states = self._split_state(new_snap.state)
+        staged: List[Tuple[Any, Any]] = []
+        prepared: List[Tuple[ShardMember, int, Any]] = []
+        try:
+            for member in self.members:
+                pool = getattr(getattr(member.session, "backend", None),
+                               "pool", None)
+                staged.append((pool,
+                               pool.mark() if pool is not None else None))
+                for pidx in member.resident:
+                    new_state = shard_states.get(pidx)
+                    if new_state == self._shard_states.get(pidx):
+                        continue
+                    base = member.base_graphs.get(pidx)
+                    if base is None:  # pragma: no cover — resident ⊆ built
+                        continue
+                    if new_state is None:
+                        # the shard's slice emptied out: back to the base
+                        prepared.append((member, pidx, base))
+                        continue
+                    with self._bracket(member.index):
+                        prepared.append((member, pidx, self._overlay_graph(
+                            member.session, base, new_state,
+                            new_snap.snapshot_version)))
+            if self.wal is not None:
+                from caps_tpu.relational.updates import \
+                    delta_state_to_payload
+                self.wal.append(new_snap.snapshot_version,
+                                delta_state_to_payload(new_snap.state))
+        except BaseException:
+            for pool, mark in staged:
+                if pool is not None:
+                    pool.rollback(mark)
+            self._commit_rollbacks_c.inc()
+            raise
+        for member, pidx, graph in prepared:
+            old, cost = member.resident[pidx]
+            if old is not graph:
+                token = getattr(old, "_plan_token", None)
+                if token is not None:
+                    try:
+                        member.session.plan_cache.evict_graph(token)
+                    except Exception:  # pragma: no cover — accounting
+                        pass
+            member.resident[pidx] = (graph, cost)
+        self._shard_states = shard_states
+        self._commits_c.inc()
+
     def quarantine_family(self, query: str,
                           params: Mapping[str, Any]) -> None:
         """Poisoned-plan quarantine, group-routed: evict the cached
@@ -896,7 +1137,7 @@ class ShardGroup:
         from caps_tpu.serve.failure import quarantine_plan_state
         route = self._route(query)
         params = dict(params or {})
-        session, graph = self.cross_session, self.cross_graph
+        session, graph = self.cross_session, self._versioned.current()
         if route is not None:
             kind, token = route
             value = token if kind == "lit" else params.get(token)
@@ -1121,7 +1362,7 @@ class ShardGroup:
         it)."""
         try:
             with self.lock, self._bracket(None), cancel_scope(None):
-                self.cross_graph.cypher(_CANARY_QUERY)
+                self._versioned.current().cypher(_CANARY_QUERY)
             return True
         except BaseException:
             return False
@@ -1136,6 +1377,7 @@ class ShardGroup:
         try:
             fresh = self._member_session()
             resident: "OrderedDict[int, Tuple[Any, int]]" = OrderedDict()
+            bases: Dict[int, Any] = {}
             measured: Dict[int, int] = {}
             with self.lock, self._bracket(member.index):
                 from caps_tpu.obs.ledger import tables_nbytes
@@ -1146,10 +1388,19 @@ class ShardGroup:
                     if resident and budget is not None \
                             and used + cost > budget:
                         continue
-                    graph = self.partitions[pidx].build(fresh)
+                    built = self.partitions[pidx].build(fresh)
                     measured[pidx] = tables_nbytes(
-                        tuple(graph.node_tables)
-                        + tuple(graph.rel_tables))
+                        tuple(built.node_tables)
+                        + tuple(built.rel_tables))
+                    graph = built
+                    # committed writes survive the rebuild: the shard's
+                    # current overlay re-anchors on the fresh base
+                    sstate = self._shard_states.get(pidx)
+                    if sstate is not None:
+                        graph = self._overlay_graph(
+                            fresh, built, sstate,
+                            self._versioned.current().snapshot_version)
+                    bases[pidx] = built
                     resident[pidx] = (graph, cost)
                     used += cost
                 # the canary runs the rebuilt member's own operator
@@ -1159,6 +1410,7 @@ class ShardGroup:
                     probe_graph.cypher(_CANARY_QUERY)
                 member.session = fresh
                 member.resident = resident
+                member.base_graphs = bases
                 member.measured_nbytes = measured
                 member.incarnation += 1
                 member.rebuilds += 1
@@ -1204,6 +1456,11 @@ class ShardGroup:
         t = self._maint_thread
         if t is not None and t.is_alive():
             t.join(timeout=5.0)
+        if self.wal is not None:
+            try:
+                self.wal.close()
+            except Exception:  # pragma: no cover — shutdown best-effort
+                pass
         with _gauge_guard:
             live = getattr(self._registry, "_shard_live_groups", [])
             if self in live:
@@ -1249,6 +1506,8 @@ class ShardGroup:
             "name": self.name,
             "index": self.index,
             "state": self.health(),
+            "version": self._versioned.current().snapshot_version,
+            "durable": self.wal is not None,
             "partitions": len(self.partitions),
             "partition_property": self.config.partition_property,
             "cross_shard_meshed": self.cross_meshed,

@@ -17,6 +17,14 @@ node.  ``faults.slow_network`` / ``faults.drop_connection``
 (testing/faults.py) patch :func:`send_frame` under the shared fault
 lock, which makes router failover tests deterministic.
 
+Durable fleets fence writes AT this layer: write frames carry the
+router's last-known lease ``epoch`` field, the owning backend checks it
+against the live lease before staging anything
+(serve/fleet.py ``_fence_write``), and a mismatch reconstructs as the
+typed :class:`~caps_tpu.serve.errors.StaleEpoch` on the caller's side —
+``epoch`` / ``lease_epoch`` / ``owner`` payload fields intact — so a
+zombie owner's frames die on the wire instead of splitting the log.
+
 Frame traffic counts under ``wire.*`` in the process-global registry
 (frames/bytes in both directions, drops), so a fleet soak can assert
 how much actually crossed the wire.

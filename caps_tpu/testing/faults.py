@@ -39,6 +39,14 @@ This module provides them:
 * :func:`flaky_compaction` — fail a deterministic fraction of
   compaction folds, scoped to the compaction thread only (serving
   and writes never see it);
+* :func:`torn_wal` — tear the next commit-log frame write mid-frame
+  (caps_tpu/durability): the on-disk image is exactly what a SIGKILL
+  leaves, so crash-recovery tests can prove the torn tail drops
+  honestly without killing a process;
+* :func:`failing_fsync` — fail the next commit-log fsync with a
+  ``caps_wal_fault``-marked OSError (the "disk went away at the
+  durability barrier" probe: typed transient error, never a silent
+  acknowledgement);
 * :func:`stale_cache` — forge a wrong-version result-cache entry
   (relational/result_cache.py) at the load seam, proving the
   snapshot-version check rejects it (a served forgery raises a fresh
@@ -963,6 +971,79 @@ def stale_statistics(graph, scale: float = 0.001):
         yield distorted
     finally:
         del graph.statistics
+
+
+@contextlib.contextmanager
+def torn_wal(n_bytes: int = 6, n_times: Optional[int] = 1):
+    """While active, the next ``n_times`` commit-log frame writes TEAR:
+    only the first ``n_bytes`` bytes of the frame reach the file (then
+    a flush, then a fresh ``caps_wal_fault``-marked RuntimeError) — the
+    on-disk image is exactly what a SIGKILL mid-write leaves.
+    Deliberately NOT an OSError: ``CommitLog.append``'s OSError path
+    truncates the partial frame away (clean-failure containment), and
+    this injector exists to prove RECOVERY drops a torn tail honestly,
+    so the torn bytes must survive on disk.  Patches the
+    ``durability/wal.py`` module attribute under the shared fault lock;
+    injections count ``faults.injected.torn_wal``.  Yields the
+    budget."""
+    from caps_tpu.durability import wal
+    budget = _Budget(n_times)
+
+    with OPERATOR_PATCH._lock:
+        orig = wal._write_frame
+
+        def tearing(f, body):
+            if budget.take():
+                _count_injection("torn_wal")
+                frame = wal.frame_bytes(body)
+                f.write(frame[:max(0, int(n_bytes))])
+                f.flush()
+                ex = RuntimeError(
+                    f"injected torn WAL write ({n_bytes} of "
+                    f"{len(frame)} bytes reached disk)")
+                ex.caps_wal_fault = True
+                raise ex
+            return orig(f, body)
+
+        wal._write_frame = tearing
+    try:
+        yield budget
+    finally:
+        with OPERATOR_PATCH._lock:
+            wal._write_frame = orig
+
+
+@contextlib.contextmanager
+def failing_fsync(n_times: Optional[int] = 1):
+    """While active, the next ``n_times`` commit-log fsyncs fail with a
+    fresh ``caps_wal_fault``-marked OSError.  The commit must abort
+    with a typed TRANSIENT
+    :class:`~caps_tpu.serve.errors.WalWriteError` — never a silent
+    acknowledgement — with the graph unchanged, and a retried write
+    must succeed once the device heals.  Patches the
+    ``durability/wal.py`` module attribute under the shared fault lock;
+    injections count ``faults.injected.failing_fsync``.  Yields the
+    budget."""
+    from caps_tpu.durability import wal
+    budget = _Budget(n_times)
+
+    with OPERATOR_PATCH._lock:
+        orig = wal._fsync
+
+        def failing(f):
+            if budget.take():
+                _count_injection("failing_fsync")
+                ex = OSError("injected fsync failure")
+                ex.caps_wal_fault = True
+                raise ex
+            return orig(f)
+
+        wal._fsync = failing
+    try:
+        yield budget
+    finally:
+        with OPERATOR_PATCH._lock:
+            wal._fsync = orig
 
 
 class FaultPlan:

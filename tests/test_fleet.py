@@ -20,8 +20,9 @@ from caps_tpu.serve.errors import (Cancelled, CancellationError, CircuitOpen,
                                    FleetUnavailable, Overloaded, QueryFailed,
                                    ReplicationUnsupported, ServeError,
                                    ServerClosed, ShardMemberDown,
-                                   ShardingUnsupported, WaitTimeout,
-                                   WireError, error_from_payload)
+                                   ShardingUnsupported, StaleEpoch,
+                                   WaitTimeout, WalWriteError, WireError,
+                                   error_from_payload)
 from caps_tpu.serve.fleet import (BackendSpec, FleetBackend,
                                   foaf_create_script, rows_digest)
 from caps_tpu.serve.router import FleetRouter, HashRing, RouterConfig
@@ -66,6 +67,8 @@ ERROR_SAMPLES = (
     ReplicationUnsupported("graph cannot re-ingest"),
     ShardingUnsupported("writes do not shard"),
     ShardMemberDown("member rebuilding", member=3),
+    WalWriteError("WAL append failed (version 7): fsync failed"),
+    StaleEpoch("zombie owner fenced", epoch=1, lease_epoch=2, owner="b1"),
     CancellationError("cancelled mid-plan", phase="plan"),
     DeadlineExceeded("execute", 0.5, 0.7531),
     DeadlineExceeded("queued", None, 1.25),

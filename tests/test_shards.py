@@ -624,14 +624,20 @@ def test_retry_target_excludes_every_failed_index():
     assert rs.retry_target([2, 0, 1]).index == 1
 
 
-def test_writes_rejected_on_group_graphs():
+def test_writes_served_on_group_graphs():
+    """The durable-writes PR lifts the old write rejection: a CREATE
+    through a sharded server commits on the group's internal lineage
+    and is visible to both the routed and cross-shard read paths."""
     session = _session()
     graph = _graph(session)
     server = QueryServer(session, graph=graph, start=False,
                          config=ServerConfig(shards=2))
     h = server.submit("CREATE (n:Person {id: 99, name: 'Zed'})")
     _drive(server, server.shard_groups[0])
-    assert isinstance(h.exception(timeout=5), ShardingUnsupported)
+    assert h.exception(timeout=5) is None
+    h2 = server.submit(Q_SINGLE, graph=graph, parameters={"id": 99})
+    _drive(server, server.shard_groups[0])
+    assert h2.result(timeout=5).to_maps() == [{"name": "Zed"}]
     server.shutdown(drain=False)
 
 
