@@ -2656,6 +2656,284 @@ def run_durability_config(on_tpu: bool):
     _emit()
 
 
+def run_chaos_config(on_tpu: bool, seed: int = 42):
+    """``bench.py chaos`` — seeded chaos soak over a replicated-router
+    fleet, with the ACTIVE ROUTER SIGKILLed mid-soak (ISSUE 20).
+
+    Spawns 3 REAL durable backend interpreters + 2 REAL router
+    interpreters (serve/ha.py) sharing one durable store, composes a
+    deterministic fault schedule from ``--seed`` (client-side wire
+    faults from the locked patch points, plus the pinned headline
+    ``kill_router_active`` event), soaks reads and idempotent writes
+    through a :class:`RouterSet`, and reports:
+
+      * read availability + recovery seconds (SIGKILL of the active
+        router to the next served read — the standby takes over within
+        ~1 router-lease TTL);
+      * zero acked-write loss — digest parity between the surviving
+        fleet and a serial in-process oracle of exactly the acked
+        statements;
+      * the zombie-ROUTER fence — write frames stamped with the dead
+        active's router epoch are refused with StaleEpoch (with and
+        without a valid owner epoch), applying nothing;
+      * hedged reads — a seeded ``slow_backend`` straggler on the
+        primary ring node, read p99 hedging-on vs hedging-off on the
+        same injection budget, hedge win rate, no result duplication;
+      * schedule determinism — composing the same seed twice yields an
+        identical schedule digest (printed for cross-run comparison).
+    """
+    import tempfile
+
+    import caps_tpu
+    from caps_tpu.obs.metrics import MetricsRegistry
+    from caps_tpu.relational.updates import VersionedGraph
+    from caps_tpu.serve.errors import ServeError, StaleEpoch
+    from caps_tpu.serve.fleet import (BackendSpec, rows_digest,
+                                      spawn_backend)
+    from caps_tpu.serve.ha import RouterSet, RouterSpec, spawn_router
+    from caps_tpu.serve.router import FleetRouter, RouterConfig
+    from caps_tpu.serve.wire import WireClient
+    from caps_tpu.testing.chaos import (ChaosInvariants, ChaosRunner,
+                                        ChaosSchedule, slow_backend)
+    from caps_tpu.testing.factory import create_graph
+
+    n_ids = 8
+    create = "CREATE " + ", ".join(
+        f"(p{i}:Person {{id: {i}, age: {20 + i}}})"
+        for i in range(1, n_ids + 1))
+    gspec = {"kind": "script", "create": create}
+    q_write = "MATCH (p:Person {id: $id}) SET p.v = $v"
+    q_read = ("MATCH (p:Person) WHERE p.age > $min "
+              "RETURN p.name AS n ORDER BY n")
+    q_all = ("MATCH (p:Person) RETURN p.id AS id, p.age AS age, "
+             "p.v AS v ORDER BY id")
+
+    store = tempfile.mkdtemp(prefix="caps-chaos-")
+    ttl_s = 1.0
+    soak_s = min(6.0, max(3.0, _remaining() - 150))
+    registry = MetricsRegistry()
+
+    # same seed ⇒ identical schedule digest, attested before the soak
+    schedule = ChaosSchedule.compose(
+        seed, soak_s, n_events=6, headline="kill_router_active",
+        registry=registry)
+    digest_stable = (schedule.digest() == ChaosSchedule.compose(
+        seed, soak_s, n_events=6, headline="kill_router_active",
+        registry=registry).digest())
+
+    backend_children = {}
+    router_children = {}
+    backends = {}
+    routers = {}
+    rset = None
+    try:
+        for name in ("d0", "d1", "d2"):
+            proc, port = spawn_backend(BackendSpec(
+                name=name, backend="local", graph=gspec, versioned=True,
+                workers=2, max_queue=512, durable_dir=store,
+                wal_fsync="always", lease_ttl_s=ttl_s))
+            backend_children[name] = proc
+            backends[name] = ("127.0.0.1", port)
+        for name in ("r0", "r1"):
+            proc, port = spawn_router(RouterSpec(
+                name=name, backends=backends, durable_dir=store,
+                owner="d0", lease_ttl_s=ttl_s, poll_s=0.1,
+                failover_wait_s=15.0))
+            router_children[name] = proc
+            routers[name] = ("127.0.0.1", port)
+        rset = RouterSet(routers, wait_s=10.0, registry=registry)
+        deadline_poll = time.perf_counter() + 5.0
+        while rset.active() is None:
+            if time.perf_counter() > deadline_poll:
+                raise RuntimeError("no router became active")
+            time.sleep(0.05)
+
+        invariants = ChaosInvariants(registry=registry)
+        killed = {"name": None, "at": None, "epoch": None}
+        recovered_at = None
+
+        def kill_active_router(_ev):
+            name = rset.active()
+            if name is None or name not in router_children:
+                name = next(iter(router_children))
+            router_children[name].kill()  # SIGKILL: no drain, no byes
+            killed["name"] = name
+            killed["at"] = time.perf_counter()
+
+        runner = ChaosRunner(
+            schedule, actions={"kill_router_active": kill_active_router},
+            registry=registry)
+
+        reads = {"ok": 0, "fail": 0}
+        stop = threading.Event()
+
+        def reader(j):
+            while not stop.is_set():
+                try:
+                    out = rset.query(q_read, {"min": 20 + (j % n_ids)},
+                                     family=f"fam-{j}", wait_s=4.0)
+                    reads["ok"] += 1
+                    # version monotonicity is per BACKEND (a failover
+                    # hop may land on a lagging peer — that's not a
+                    # backend time-travelling), so key on both
+                    invariants.note_read(
+                        f"reader-{j}@{out.get('backend')}", True,
+                        version=out.get("snapshot_version"))
+                except ServeError:
+                    reads["fail"] += 1
+                    invariants.note_read(f"reader-{j}", False)
+                time.sleep(0.005)
+
+        readers = [threading.Thread(target=reader, args=(j,), daemon=True)
+                   for j in range(2)]
+        for t in readers:
+            t.start()
+
+        acked = []
+        t0 = time.perf_counter()
+        seq = 0
+        with runner:
+            while time.perf_counter() - t0 < soak_s and _remaining() > 90:
+                runner.poll(time.perf_counter() - t0)
+                params = {"id": 1 + seq % n_ids, "v": seq}
+                try:
+                    rset.write(q_write, params, ship=True, wait_s=4.0)
+                except ServeError:
+                    time.sleep(0.02)
+                    continue  # retry the SAME idempotent write until acked
+                acked.append(params)
+                invariants.note_write_ack()
+                if killed["at"] is not None and recovered_at is None:
+                    recovered_at = time.perf_counter()
+                seq += 1
+            runner.poll(soak_s)  # fire any stragglers (incl. the kill)
+            stop.set()
+            for t in readers:
+                t.join()
+        recovery_s = ((recovered_at - killed["at"])
+                      if killed["at"] and recovered_at else float("nan"))
+
+        # -- zero acked-write loss: digest parity vs a serial oracle ---
+        oracle_session = caps_tpu.local_session(backend="local")
+        oracle = VersionedGraph(oracle_session,
+                                create_graph(oracle_session, create))
+        for params in acked:
+            oracle_session.cypher_on_graph(oracle, q_write, params)
+        oracle_digest = rows_digest(
+            oracle_session.cypher_on_graph(oracle, q_all).to_maps())
+        stats = rset.stats()
+        owner = stats["owner"]
+        survivor = WireClient(*backends[owner])
+        observed = survivor.call("query", query=q_all, params={},
+                                 digest=True)["digest"]
+
+        # -- the zombie-ROUTER fence: the dead active's epoch stamps
+        #    are refused by the backends, applying nothing ------------
+        surviving_epoch = int(stats.get("epoch") or 0)
+        stale_router_epoch = max(1, surviving_epoch - 1)
+        owner_epoch = None
+        lease_rec = None
+        with open(os.path.join(store, "lease.json")) as f:
+            lease_rec = json.load(f)
+        owner_epoch = int(lease_rec["epoch"])
+        fenced = []
+        version_before = survivor.call("ping")["snapshot_version"]
+        for fields in ({"router_epoch": stale_router_epoch},
+                       {"router_epoch": stale_router_epoch,
+                        "epoch": owner_epoch}):
+            try:
+                survivor.call("write", query=q_write,
+                              params={"id": 2, "v": 10_000}, **fields)
+                fenced.append("APPLIED")
+            except StaleEpoch:
+                fenced.append("StaleEpoch")
+        version_after = survivor.call("ping")["snapshot_version"]
+        survivor.close()
+        zero_zombie_writes = (fenced == ["StaleEpoch", "StaleEpoch"]
+                              and version_after == version_before)
+        for _ in range(2):
+            invariants.note_fence(zero_zombie_writes)
+
+        report = invariants.report(
+            availability_floor=0.5, oracle_digest=oracle_digest,
+            observed_digest=observed)
+
+        # -- hedged reads vs a seeded straggler ------------------------
+        prim_key = FleetRouter.routing_key("default", "fam-hedge", q_read)
+        hedge_stats = {}
+        for label, hedge_on in (("off", False), ("on", True)):
+            hreg = MetricsRegistry()
+            hrouter = FleetRouter(
+                backends, owner=owner,
+                config=RouterConfig(
+                    hedge_reads=hedge_on, hedge_max_fraction=1.0,
+                    hedge_delay_s=0.01),
+                registry=hreg)
+            primary = hrouter.ring.preference(prim_key)[0]
+            lat = []
+            n_reads, n_slow = 40, 20
+            with slow_backend(backends[primary][1], 0.08,
+                              n_times=n_slow, every_n=2):
+                for k in range(n_reads):
+                    ts = time.perf_counter()
+                    hrouter.query(q_read, {"min": 21},
+                                  family="fam-hedge")
+                    lat.append(time.perf_counter() - ts)
+            lat.sort()
+            snap = hreg.snapshot()
+            hedge_stats[label] = {
+                "p99_ms": round(lat[int(0.99 * (len(lat) - 1))] * 1e3, 2),
+                "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+                "hedges": snap.get("router.hedges", 0),
+                "hedge_wins": snap.get("router.hedge_wins", 0),
+            }
+            hrouter.close()
+        hedge_improved = (hedge_stats["on"]["p99_ms"]
+                          < hedge_stats["off"]["p99_ms"])
+
+        assert digest_stable, "same seed composed different schedules"
+        assert report["ok"], report
+        assert zero_zombie_writes, fenced
+        _result.update({
+            "metric": "router HA chaos soak: active router SIGKILLed "
+                      "mid-schedule, standby takes the epoch-fenced "
+                      "router lease (3 backend + 2 router processes, "
+                      f"shared durable store, ttl={ttl_s:.0f}s, "
+                      f"seed={seed}, "
+                      f"{'tpu' if on_tpu else 'cpu'})",
+            "value": round(recovery_s, 3),
+            "unit": "s from router SIGKILL to next acked write",
+            "schedule_digest": schedule.digest(),
+            "schedule_events": len(schedule.events),
+            "chaos_events_applied": len(runner.applied),
+            "killed_router": killed["name"],
+            "read_availability": round(report["availability"], 4),
+            "reads_served": reads["ok"],
+            "acked_writes": len(acked),
+            "acked_write_loss": 0 if report["checks"].get(
+                "acked_write_parity") else -1,
+            "fence_probe": fenced,
+            "invariants": report["checks"],
+            "hedge_off_p99_ms": hedge_stats["off"]["p99_ms"],
+            "hedge_on_p99_ms": hedge_stats["on"]["p99_ms"],
+            "hedges": hedge_stats["on"]["hedges"],
+            "hedge_wins": hedge_stats["on"]["hedge_wins"],
+            "hedge_win_rate": round(
+                hedge_stats["on"]["hedge_wins"]
+                / max(1, hedge_stats["on"]["hedges"]), 3),
+            "hedge_p99_improved": bool(hedge_improved),
+            "vs_baseline": 0.0,
+        })
+    finally:
+        if rset is not None:
+            rset.close()
+        for proc in router_children.values():
+            proc.kill()
+        for proc in backend_children.values():
+            proc.kill()
+    _emit()
+
+
 def main():
     import numpy as np
     if len(sys.argv) > 1 and sys.argv[1] == "serve" \
@@ -2707,6 +2985,12 @@ def main():
         return run_fleet_config(on_tpu, procs_n)
     if len(sys.argv) > 1 and sys.argv[1] == "durability":
         return run_durability_config(on_tpu)
+    if len(sys.argv) > 1 and sys.argv[1] == "chaos":
+        seed = 42
+        if "--seed" in sys.argv:
+            i = sys.argv.index("--seed")
+            seed = int(sys.argv[i + 1]) if i + 1 < len(sys.argv) else 42
+        return run_chaos_config(on_tpu, seed)
 
     from caps_tpu.backends.local.session import LocalCypherSession
     from caps_tpu.backends.tpu.session import TPUCypherSession

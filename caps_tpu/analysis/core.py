@@ -41,8 +41,8 @@ BANNED_TIME_READS = frozenset({
 DEFAULT_SERVE_MODULES = frozenset({
     "__init__.py", "admission.py", "batcher.py", "breaker.py",
     "compaction.py", "deadline.py", "devices.py", "errors.py",
-    "failure.py", "fleet.py", "request.py", "retry.py", "router.py",
-    "server.py", "shards.py", "warmup.py", "wire.py",
+    "failure.py", "fleet.py", "ha.py", "request.py", "retry.py",
+    "router.py", "server.py", "shards.py", "warmup.py", "wire.py",
 })
 
 
@@ -76,7 +76,7 @@ class AnalysisConfig:
     lock_dirs: Tuple[str, ...] = (
         "caps_tpu/serve", "caps_tpu/obs", "caps_tpu/relational",
         "caps_tpu/okapi", "caps_tpu/durability",
-        "caps_tpu/testing/faults.py")
+        "caps_tpu/testing/faults.py", "caps_tpu/testing/chaos.py")
     #: the one sanctioned time source (exempt from clock-discipline)
     clock_exempt: Tuple[str, ...] = ("caps_tpu/obs/clock.py",)
     #: modules the clock-discipline pass MUST see — same vacuity guard
@@ -108,7 +108,8 @@ class AnalysisConfig:
     exception_markers: frozenset = frozenset({
         "caps_failed_op", "caps_device_index", "caps_transient",
         "caps_device_fault", "caps_shard_member", "caps_wcoj_fault",
-        "caps_algo_fault", "caps_stale_cache", "caps_wal_fault"})
+        "caps_algo_fault", "caps_stale_cache", "caps_wal_fault",
+        "caps_chaos_fault"})
     #: sanctioned first segments of dotted metric names
     metric_prefixes: frozenset = frozenset({
         "plan_cache", "query", "session", "ops", "serve", "collectives",
@@ -116,7 +117,8 @@ class AnalysisConfig:
         "updates", "compaction", "telemetry", "slo", "opstats",
         "compile", "mem", "slowlog", "warmup", "bucket", "planstore",
         "cost", "stats", "replan", "shard", "paging", "wcoj",
-        "fleet", "router", "wire", "rescache", "algo", "wal"})
+        "fleet", "router", "wire", "rescache", "algo", "wal",
+        "chaos"})
     #: the structured event log module (obs/log.py) and the correlation
     #: fields every emit site must pass — the structured-log pass's
     #: contract (a missing module is a finding, not a silent skip)

@@ -7,12 +7,14 @@ fleet backend may accept writes (epoch-fenced, so a deposed owner can
 never split-brain).  Everything here is host-side JSON — compiled
 executables and device buffers never touch the log (docs/tpu.md).
 """
-from caps_tpu.durability.lease import LeaseStore
+from caps_tpu.durability.lease import (DEFAULT_LEASE_NAME,
+                                       ROUTER_LEASE_NAME, LeaseStore)
 from caps_tpu.durability.wal import (CommitLog, WalRecovery,
                                      compose_delta_payloads,
                                      empty_payload, scan_durable_dir)
 
 __all__ = [
-    "CommitLog", "LeaseStore", "WalRecovery", "compose_delta_payloads",
+    "CommitLog", "DEFAULT_LEASE_NAME", "LeaseStore",
+    "ROUTER_LEASE_NAME", "WalRecovery", "compose_delta_payloads",
     "empty_payload", "scan_durable_dir",
 ]

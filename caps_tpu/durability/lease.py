@@ -19,6 +19,13 @@ Liveness is a TTL on ``renewed_t``: the owner renews on every write, and
 a peer may steal only after the TTL has lapsed (``clock.now`` is the
 sanctioned monotonic source — CLOCK_MONOTONIC is machine-wide, so
 cross-process comparisons on the one shared host hold).
+
+The store is **namespaced** by ``lease_name``: the default namespace
+(``lease``) arbitrates the fleet's single write owner, and the router
+tier (serve/ha.py) arbitrates its active/standby election through a
+second namespace (``lease-router``) in the SAME directory with the SAME
+CAS machinery — one fence implementation, two independently-epoched
+leases that can never collide on a claim file.
 """
 from __future__ import annotations
 
@@ -30,19 +37,24 @@ from caps_tpu.obs import clock
 from caps_tpu.obs.lockgraph import make_lock
 from caps_tpu.obs.metrics import MetricsRegistry, global_registry
 
-_LEASE_NAME = "lease.json"
-_CLAIM_PREFIX = "lease.epoch-"
+#: the default namespace — the fleet's write-owner lease
+DEFAULT_LEASE_NAME = "lease"
+#: the router tier's active/standby lease namespace (serve/ha.py):
+#: same directory, same CAS machinery, independent epochs
+ROUTER_LEASE_NAME = "lease-router"
 _CLAIM_SUFFIX = ".claim"
 
 
 class LeaseStore:
-    """One fleet's write lease, arbitrated through the shared store."""
+    """One epoch-fenced lease, arbitrated through the shared store."""
 
     def __init__(self, dir_path: str, *, ttl_s: float = 5.0,
+                 lease_name: str = DEFAULT_LEASE_NAME,
                  registry: Optional[MetricsRegistry] = None,
                  event_log=None):
         self.dir_path = os.path.abspath(dir_path)
         self.ttl_s = float(ttl_s)
+        self.lease_name = str(lease_name)
         self._registry = registry if registry is not None else global_registry()
         self._event_log = event_log
         self._lock = make_lock("lease.LeaseStore._lock")
@@ -50,11 +62,15 @@ class LeaseStore:
 
     @property
     def lease_path(self) -> str:
-        return os.path.join(self.dir_path, _LEASE_NAME)
+        return os.path.join(self.dir_path, f"{self.lease_name}.json")
+
+    @property
+    def _claim_prefix(self) -> str:
+        return f"{self.lease_name}.epoch-"
 
     def _claim_path(self, epoch: int) -> str:
         return os.path.join(self.dir_path,
-                            f"{_CLAIM_PREFIX}{epoch:08d}{_CLAIM_SUFFIX}")
+                            f"{self._claim_prefix}{epoch:08d}{_CLAIM_SUFFIX}")
 
     # -- reads ---------------------------------------------------------------
 
@@ -158,11 +174,12 @@ class LeaseStore:
             names = os.listdir(self.dir_path)
         except OSError:
             return
+        prefix = self._claim_prefix
         for fname in names:
-            if not (fname.startswith(_CLAIM_PREFIX)
+            if not (fname.startswith(prefix)
                     and fname.endswith(_CLAIM_SUFFIX)):
                 continue
-            stem = fname[len(_CLAIM_PREFIX):-len(_CLAIM_SUFFIX)]
+            stem = fname[len(prefix):-len(_CLAIM_SUFFIX)]
             try:
                 if int(stem) <= upto_epoch:
                     os.unlink(os.path.join(self.dir_path, fname))

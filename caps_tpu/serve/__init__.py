@@ -45,7 +45,12 @@ The layer between many client threads and one engine session
                         spawned interpreters), snapshot export/install
     serve/router.py     stateless consistent-hash router: plan-family
                         affinity, load-aware spill, ring-degrading
-                        failover, snapshot shipping, fleet-wide scrape
+                        failover, snapshot shipping, fleet-wide scrape,
+                        end-to-end deadline budgets, hedged reads
+    serve/ha.py         router high availability: epoch-fenced
+                        active/standby routers on a second lease
+                        namespace, zombie-router fencing, the
+                        RouterSet client facade
 
 Engine hooks this package owns: ``RelationalCypherSession.cypher_batch``
 (one batched pass over a cached plan), the deadline checkpoints in
@@ -110,6 +115,11 @@ _LAZY = {
     "HashRing": "caps_tpu.serve.router",
     "RouterConfig": "caps_tpu.serve.router",
     "FleetRouter": "caps_tpu.serve.router",
+    # router HA (serve/ha.py): replicated routers behind one lease
+    "HARouter": "caps_tpu.serve.ha",
+    "RouterSet": "caps_tpu.serve.ha",
+    "RouterSpec": "caps_tpu.serve.ha",
+    "spawn_router": "caps_tpu.serve.ha",
 }
 
 __all__ = [

@@ -36,7 +36,7 @@ import sys
 import threading
 from typing import Any, Dict, Optional, Tuple
 
-from caps_tpu.durability.lease import LeaseStore
+from caps_tpu.durability.lease import ROUTER_LEASE_NAME, LeaseStore
 from caps_tpu.durability.wal import (CommitLog, compose_delta_payloads,
                                      empty_payload, scan_durable_dir)
 from caps_tpu.obs import clock
@@ -208,6 +208,7 @@ class FleetBackend:
         #: the spec has no durable_dir / the graph is not versioned
         self.wal: Optional[CommitLog] = None
         self.lease: Optional[LeaseStore] = None
+        self.router_lease: Optional[LeaseStore] = None
         #: the lease epoch this backend last wrote under (stamped on
         #: write acks so routers can fence their own staleness)
         self.write_epoch: Optional[int] = None
@@ -241,6 +242,12 @@ class FleetBackend:
             event_log=getattr(self.session, "event_log", None))
         self.lease = LeaseStore(spec.durable_dir, ttl_s=spec.lease_ttl_s,
                                 registry=self._registry)
+        #: the ROUTER tier's lease (serve/ha.py) — read-only here: the
+        #: backend fences write-coordination frames from deposed zombie
+        #: routers against it, exactly like zombie owners
+        self.router_lease = LeaseStore(
+            spec.durable_dir, ttl_s=spec.lease_ttl_s,
+            lease_name=ROUTER_LEASE_NAME, registry=self._registry)
         self._base_overlay = empty_payload()
         rec = self.wal.recover()
         if rec.version > 0:
@@ -281,6 +288,25 @@ class FleetBackend:
                                 self._base_overlay, epoch=self.write_epoch)
         except WalWriteError:
             self._registry.counter("wal.checkpoint_failures").inc()
+
+    def _fence_router(self, frame_router_epoch: Optional[int]) -> None:
+        """The router-tier fence (serve/ha.py): a write-coordination
+        frame stamped with a ROUTER epoch older than the published
+        router lease's comes from a deposed zombie active router —
+        refuse it exactly like a zombie owner's.  Frames without a
+        router epoch pass (single-router deployments carry none), and
+        TTL expiry is irrelevant here: only a SUCCESSOR bumping the
+        epoch deposes the stamp's holder."""
+        if frame_router_epoch is None or self.router_lease is None:
+            return
+        lease = self.router_lease.read()
+        if lease is not None and int(frame_router_epoch) != lease["epoch"]:
+            self._registry.counter("wal.fenced_writes").inc()
+            raise StaleEpoch(
+                f"stale ROUTER epoch fenced at backend "
+                f"{self.spec.name!r} — a newer active router holds the "
+                f"router lease", epoch=int(frame_router_epoch),
+                lease_epoch=lease["epoch"], owner=lease["owner"])
 
     def _fence_write(self, frame_epoch: Optional[int]) -> None:
         """The split-brain fence, checked before EVERY durable write:
@@ -445,6 +471,7 @@ class FleetBackend:
                 f"backend {self.spec.name!r} serves a non-versioned "
                 f"graph; writes need a versioned owner")
         if self.lease is not None:
+            self._fence_router(msg.get("router_epoch"))
             self._fence_write(msg.get("epoch"))
         rows, info = self._submit(msg)
         out = {"rows": rows,

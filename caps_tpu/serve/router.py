@@ -40,19 +40,27 @@ a stale view (or a zombie owner) is fenced, never split-brained.
 from __future__ import annotations
 
 import bisect
+import collections
 import dataclasses
 import hashlib
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from caps_tpu.obs import clock
-from caps_tpu.obs.lockgraph import make_rlock
+from caps_tpu.obs.lockgraph import make_lock, make_rlock
 from caps_tpu.obs.metrics import (MetricsRegistry, global_registry,
                                   merge_snapshots)
-from caps_tpu.serve.errors import (FleetUnavailable, Overloaded, ServeError,
-                                   ServerClosed, StaleEpoch, WireError)
+from caps_tpu.obs.telemetry import RollingHistogram
+from caps_tpu.serve.errors import (DeadlineExceeded, FleetUnavailable,
+                                   Overloaded, ServeError, ServerClosed,
+                                   StaleEpoch, WireError)
 from caps_tpu.serve.wire import WireClient
 
 _UNSET = object()
+
+#: per-family latency windows kept for hedge-delay derivation (LRU —
+#: same bound discipline as ServingTelemetry's family windows)
+_MAX_LATENCY_FAMILIES = 64
 
 
 def _ring_hash(key: str) -> int:
@@ -140,6 +148,19 @@ class RouterConfig:
     #: how long a failover election waits for the dead owner's lease
     #: TTL to lapse before giving up (durable fleets only)
     failover_wait_s: float = 10.0
+    #: hedge reads: when the primary has not replied after the
+    #: per-family p99-derived delay, issue the SAME read to the next
+    #: preference node — first reply wins, the loser's reply is
+    #: discarded (tail tolerance for one slow backend)
+    hedge_reads: bool = False
+    #: hard bound on the hedged share of reads — hedges stop once
+    #: ``router.hedges`` would exceed this fraction of reads routed
+    hedge_max_fraction: float = 0.1
+    #: fixed hedge delay override (seconds); None derives the delay
+    #: from the family's rolling latency window at ``hedge_quantile``
+    hedge_delay_s: Optional[float] = None
+    #: quantile of the per-family latency window the hedge fires at
+    hedge_quantile: float = 0.99
 
 
 class FleetRouter:
@@ -164,6 +185,19 @@ class FleetRouter:
         #: learned from write acks and failover elections, fenced by the
         #: backends — a router holding a stale view is told so
         self._owner_epoch: Optional[int] = None
+        #: the ROUTER lease epoch (serve/ha.py): when this router runs
+        #: replicated, its HA wrapper stamps the held epoch here and
+        #: every write-coordination frame carries it — a deposed zombie
+        #: router is fenced by the backends exactly like a zombie owner
+        self.router_epoch: Optional[int] = None
+        #: per-family read-latency windows (hedge-delay source) and the
+        #: hedge-rate bound's counters — guarded by their own leaf lock
+        #: so the hedge race never contends with routing state
+        self._latency: "collections.OrderedDict[str, RollingHistogram]" = \
+            collections.OrderedDict()
+        self._latency_lock = make_lock("router.FleetRouter._latency_lock")
+        self._reads_routed = 0
+        self._hedges_issued = 0
         self.ring = HashRing(backends.keys(), vnodes=self.config.vnodes)
         self._clients = {name: WireClient(host, port,
                                           timeout_s=self.config.timeout_s)
@@ -246,6 +280,113 @@ class FleetRouter:
         parameterized workload (parameters don't change the plan)."""
         return f"{graph}|{family if family is not None else query}"
 
+    def _observe_latency(self, key: str, elapsed_s: float) -> None:
+        with self._latency_lock:
+            hist = self._latency.get(key)
+            if hist is None:
+                while len(self._latency) >= _MAX_LATENCY_FAMILIES:
+                    self._latency.popitem(last=False)
+                hist = self._latency[key] = RollingHistogram()
+            else:
+                self._latency.move_to_end(key)
+            hist.observe(clock.now(), elapsed_s)
+
+    def _hedge_delay(self, key: str) -> Optional[float]:
+        """The delay after which a read hedges: the configured override,
+        else the family window's p99 — None (never hedge) until the
+        window has observations, so a cold family cannot hedge off a
+        guessed latency."""
+        if self.config.hedge_delay_s is not None:
+            return float(self.config.hedge_delay_s)
+        with self._latency_lock:
+            hist = self._latency.get(key)
+            if hist is None:
+                return None
+            q = hist.quantile(clock.now(), self.config.hedge_quantile)
+        return q if q is not None and q > 0.0 else None
+
+    def _hedge_allowed(self) -> bool:
+        """Honest rate bound: hedges never exceed the configured share
+        of reads routed, so tail tolerance cannot silently double the
+        fleet's read load."""
+        with self._latency_lock:
+            return (self._hedges_issued
+                    < self.config.hedge_max_fraction
+                    * max(1, self._reads_routed))
+
+    def _hedged_call(self, primary: str, hedge_to: Optional[str],
+                     fields: Dict[str, Any], delay_s: float,
+                     wait_budget_s: float) -> Tuple[str, Any]:
+        """Race one read between ``primary`` and (after ``delay_s``
+        without a primary reply) ``hedge_to``.  First successful reply
+        wins and is the ONLY reply returned — the loser's is discarded,
+        never merged, so results cannot duplicate.  A backend whose leg
+        died at the transport level is marked dead here (health is
+        honest even when the other leg wins).  Raises the primary leg's
+        error when no leg succeeds."""
+        results: List[Tuple[str, bool, Any]] = []
+        arrived = threading.Event()
+        results_lock = threading.Lock()
+
+        def leg(name: str) -> None:
+            try:
+                item = (name, True, self._clients[name].call(
+                    "query", **fields))
+            except BaseException as ex:
+                item = (name, False, ex)
+            with results_lock:
+                results.append(item)
+                arrived.set()
+
+        threading.Thread(target=leg, args=(primary,), daemon=True,
+                         name="caps-router-read").start()
+        t0 = clock.now()
+        hedged = False
+        errors: Dict[str, BaseException] = {}
+        legs = 1
+        while True:
+            with results_lock:
+                batch, results[:] = list(results), []
+                arrived.clear()
+            for name, ok, value in batch:
+                if ok:
+                    if hedged and name != primary:
+                        self.registry.counter("router.hedge_wins").inc()
+                    return name, value
+                errors[name] = value
+                if isinstance(value, (WireError, ServerClosed)):
+                    self.mark_dead(name)
+            if len(errors) == legs:
+                if not hedged and hedge_to is not None \
+                        and self._hedge_allowed():
+                    # the primary leg FAILED before the hedge delay:
+                    # fall through and launch the hedge immediately —
+                    # it is now the only leg left
+                    pass
+                else:
+                    raise errors.get(primary,
+                                     next(iter(errors.values())))
+            elapsed = clock.now() - t0
+            if elapsed >= wait_budget_s:
+                raise DeadlineExceeded("route", wait_budget_s, elapsed)
+            if not hedged and hedge_to is not None \
+                    and (elapsed >= delay_s or primary in errors) \
+                    and self._hedge_allowed():
+                hedged = True
+                legs += 1
+                with self._latency_lock:
+                    self._hedges_issued += 1
+                self.registry.counter("router.hedges").inc()
+                threading.Thread(target=leg, args=(hedge_to,),
+                                 daemon=True,
+                                 name="caps-router-hedge").start()
+            elif len(errors) == legs:
+                raise errors.get(primary, next(iter(errors.values())))
+            horizon = wait_budget_s - elapsed
+            if not hedged and hedge_to is not None:
+                horizon = min(horizon, max(delay_s - elapsed, 0.0))
+            clock.wait(arrived, max(horizon, 0.001))
+
     def query(self, query: str,
               parameters: Optional[Dict[str, Any]] = None, *,
               family: Optional[str] = None, graph: str = "default",
@@ -255,8 +396,26 @@ class FleetRouter:
         backend's ledger/snapshot_version/queue_depth and the name it
         ran on (``backend``).  Raises the backend's typed error
         verbatim, or :class:`FleetUnavailable` when every candidate
-        ring node failed at the transport level."""
+        ring node failed at the transport level.
+
+        **Deadline fidelity**: ``deadline_s`` is the caller's TOTAL
+        budget, stamped at admission on ``obs.clock``.  Every hop —
+        spill, failover retry, hedge — forwards the *remaining* budget
+        recomputed from that stamp, never the original figure, so a
+        2-hop failover cannot silently double the caller's wall budget.
+
+        **Hedged reads** (``RouterConfig.hedge_reads``): after the
+        family's p99-derived delay without a primary reply the read is
+        ALSO issued to the next preference node; first reply wins, the
+        loser is discarded.  Hedges are rate-bounded
+        (``hedge_max_fraction``) and counted (``router.hedges`` /
+        ``router.hedge_wins``) — a hedge win is one served request,
+        never two."""
         key = self.routing_key(graph, family, query)
+        admitted = clock.now()
+        budget = (float(deadline_s)
+                  if deadline_s is not _UNSET and deadline_s is not None
+                  else None)
         prefs = self.ring.preference(key)
         candidates = [n for n in prefs if self._state[n]["live"]]
         if not candidates:
@@ -275,12 +434,37 @@ class FleetRouter:
             fields["priority"] = priority
         if digest:
             fields["digest"] = True
+        with self._latency_lock:
+            self._reads_routed += 1
         hint = 0.0
         for i, name in enumerate(candidates):
             if i:
                 self.registry.counter("router.retries").inc()
+            if budget is not None:
+                elapsed = clock.now() - admitted
+                if budget - elapsed <= 0.0:
+                    raise DeadlineExceeded("route", budget, elapsed)
+                # forward the REMAINING budget, not the original: the
+                # backend's admission clock starts fresh per hop, so a
+                # verbatim resend would extend the caller's deadline
+                fields["deadline_s"] = budget - elapsed
+            started = clock.now()
+            hedge_to = None
+            if self.config.hedge_reads and i + 1 < len(candidates):
+                hedge_to = candidates[i + 1]
             try:
-                reply = self._clients[name].call("query", **fields)
+                if hedge_to is not None:
+                    delay = self._hedge_delay(key)
+                    if delay is None:
+                        hedge_to = None
+                if hedge_to is not None:
+                    wait = (budget - (clock.now() - admitted)
+                            if budget is not None
+                            else self.config.timeout_s)
+                    name, reply = self._hedged_call(
+                        name, hedge_to, fields, delay, wait)
+                else:
+                    reply = self._clients[name].call("query", **fields)
             except (WireError, ServerClosed):
                 # the process is gone (or lame-duck draining): degrade
                 # its ring segment and retry the request on the next
@@ -292,6 +476,7 @@ class FleetRouter:
                 hint = max(hint, ex.retry_after_s)
                 self.registry.counter("router.spilled").inc()
                 continue
+            self._observe_latency(key, clock.now() - started)
             self._note_reply(name, reply)
             self.registry.counter("router.requests").inc()
             if isinstance(reply, dict):
@@ -305,7 +490,8 @@ class FleetRouter:
 
     def write(self, query: str,
               parameters: Optional[Dict[str, Any]] = None, *,
-              ship: bool = True) -> Dict[str, Any]:
+              ship: bool = True,
+              deadline_s: Any = _UNSET) -> Dict[str, Any]:
         """Route one write to the owner, then ship its post-commit
         snapshot to every live peer.  The reply carries the committed
         ``version`` and the shipping report (per-peer version + lag).
@@ -317,7 +503,18 @@ class FleetRouter:
         carries the router's known epoch, so a stale ownership view is
         fenced by the backend (:class:`StaleEpoch`) and corrected from
         the error's fields.  Non-durable fleets keep the legacy
-        behavior: owner death makes the fleet read-only until rejoin."""
+        behavior: owner death makes the fleet read-only until rejoin.
+
+        ``deadline_s`` is the caller's TOTAL budget (admission-stamped
+        here): the failover retry forwards the remaining budget, never
+        the original figure.  When this router runs replicated
+        (serve/ha.py) every frame also carries its ``router_epoch`` —
+        a deposed zombie router's coordination is fenced by the
+        backends."""
+        admitted = clock.now()
+        budget = (float(deadline_s)
+                  if deadline_s is not _UNSET and deadline_s is not None
+                  else None)
         if not self._state[self.owner]["live"]:
             if not self._failover_owner():
                 raise FleetUnavailable(
@@ -326,8 +523,17 @@ class FleetRouter:
         for attempt in (0, 1):
             fields: Dict[str, Any] = {"query": query,
                                       "params": parameters or {}}
+            if budget is not None:
+                elapsed = clock.now() - admitted
+                if budget - elapsed <= 0.0:
+                    raise DeadlineExceeded("route", budget, elapsed)
+                fields["deadline_s"] = budget - elapsed
+            elif deadline_s is not _UNSET:
+                fields["deadline_s"] = deadline_s
             if self._owner_epoch is not None:
                 fields["epoch"] = self._owner_epoch
+            if self.router_epoch is not None:
+                fields["router_epoch"] = self.router_epoch
             try:
                 reply = self._clients[self.owner].call("write", **fields)
             except WireError:
@@ -377,6 +583,10 @@ class FleetRouter:
                 continue
             if version is not None:
                 candidates.append((-int(version), name))
+        # deterministic election order: longest replayed log first,
+        # equal logs broken LEXICOGRAPHICALLY by backend name — repeated
+        # elections under chaos reproduce the same winner (the router
+        # takeover in serve/ha.py elects by the same rule)
         candidates.sort()
         for _neg_version, name in candidates:
             try:

@@ -317,6 +317,105 @@ def test_epoch_claim_is_a_compare_and_swap(tmp_path):
     assert store.acquire("b") == 2
 
 
+# -- lease edges (ISSUE 20 satellite): fake-clock TTL arithmetic, wedge
+#    vs live renewal, and the same-epoch CAS race ----------------------------
+
+class _LeaseClock:
+    """Fake for ``clock.now`` only: lease expiry is monotonic
+    arithmetic on the renewal stamp; ``clock.wall`` stays real because
+    the wedged-claim sweep ages claim FILES (mtime is wall time)."""
+
+    def __init__(self, t0: float = 1_000.0):
+        self.t = t0
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+@pytest.fixture()
+def lease_clock(monkeypatch):
+    from caps_tpu.obs import clock
+    lc = _LeaseClock()
+    monkeypatch.setattr(clock, "now", lc.now)
+    return lc
+
+
+def test_renewal_stamp_governs_expiry_not_acquisition_time(tmp_path,
+                                                           lease_clock):
+    store = LeaseStore(str(tmp_path), ttl_s=5.0)
+    rival = LeaseStore(str(tmp_path), ttl_s=5.0)
+    assert store.acquire("a") == 1
+    lease_clock.advance(4.0)
+    assert rival.acquire("b") is None
+    assert store.renew("a") is True        # the stamp moves to NOW
+    lease_clock.advance(4.0)
+    # 8s since acquisition but only 4s since the renewal stamp: the
+    # TTL is measured from the stamp on the monotonic clock, so a
+    # renewing owner can never be deposed by clock arithmetic that
+    # reaches back to its original claim (skew-free by construction)
+    assert rival.acquire("b") is None
+    assert store.holder("a") == 1
+    lease_clock.advance(1.1)               # NOW the renewal is stale
+    assert store.holder("a") is None
+    assert rival.acquire("b") == 2
+
+
+def test_wedged_claim_waits_out_a_live_renewal(tmp_path, lease_clock):
+    """A claimant that crashed between winning the O_EXCL claim and
+    publishing the lease leaves a wedge — but while the OWNER's lease
+    is live, the wedge is unreachable (the conflict path returns before
+    the claim CAS, and renewals never sweep).  Only after the owner
+    expires does the steal path break the wedge and go through."""
+    store = LeaseStore(str(tmp_path), ttl_s=5.0)
+    rival = LeaseStore(str(tmp_path), ttl_s=5.0)
+    assert store.acquire("a") == 1
+    wedge = rival._claim_path(2)
+    with open(wedge, "w"):
+        pass
+    past = time.time() - 60.0              # older than any TTL
+    os.utime(wedge, (past, past))
+    assert rival.acquire("b") is None      # live lease: conflict, no CAS
+    assert store.renew("a") is True
+    assert os.path.exists(wedge)           # renewal swept NOTHING
+    lease_clock.advance(6.0)               # the owner dies
+    assert rival.acquire("b") is None      # first attempt breaks the wedge
+    assert not os.path.exists(wedge)
+    assert rival.acquire("b") == 2
+
+
+def test_two_claimants_cas_the_same_epoch_one_wins(tmp_path, lease_clock):
+    """Both claimants read the expired lease and compute next_epoch=2;
+    the O_EXCL claim file is the CAS.  Interleave the loser BETWEEN the
+    winner's claim and its publish — the worst-case window — and
+    exactly one epoch-2 lease exists afterwards."""
+    store_b = LeaseStore(str(tmp_path), ttl_s=5.0)
+    store_c = LeaseStore(str(tmp_path), ttl_s=5.0)
+    assert store_b.acquire("a") == 1
+    lease_clock.advance(6.0)
+    results = {}
+    orig_write = store_b._write
+
+    def publish_hook(record):
+        if record["owner"] == "b" and "c" not in results:
+            # c races in AFTER b won the O_EXCL claim for epoch 2 but
+            # BEFORE b published lease.json: c sees the expired epoch-1
+            # lease, computes the SAME next epoch, and loses the CAS
+            results["c"] = store_c.acquire("c")
+        orig_write(record)
+
+    store_b._write = publish_hook
+    results["b"] = store_b.acquire("b")
+    assert results == {"b": 2, "c": None}
+    lease = store_c.read()
+    assert (lease["owner"], lease["epoch"]) == ("b", 2)
+    # the loser retries against the now-live epoch-2 lease: conflict,
+    # never a second epoch-2 publication
+    assert store_c.acquire("c") is None
+
+
 # -- commit integration: append-before-acknowledge ---------------------------
 
 @pytest.fixture
